@@ -1,0 +1,21 @@
+"""karpenter_tpu — a TPU-native re-implementation of Karpenter's capabilities.
+
+Kubernetes node autoscaling re-designed around a batched constraint-satisfaction
+solver: pending-pods x instance-types x NodePools as dense feasibility tensors
+evaluated on TPU via JAX/XLA (see `karpenter_tpu.ops` and `karpenter_tpu.solver`),
+with a lean control plane (`karpenter_tpu.controllers`) orchestrating provisioning,
+node lifecycle, and disruption against a pluggable cloud provider
+(`karpenter_tpu.cloudprovider`).
+
+Layer map (mirrors SURVEY.md §1 for the reference at /root/reference):
+  api/            L0  CRD-equivalent domain objects (NodePool, NodeClaim, Pod, ...)
+  scheduling/     L1  constraint algebra (Requirements, Taints, host ports)
+  cloudprovider/  L2  provider SPI + fake + KWOK-style simulated provider
+  controllers/    L3+L5  cluster state cache and control loops
+  solver/         L4  the scheduling core: oracle FFD + batched TPU solver
+  ops/            tensor encodings and JAX kernels backing the solver
+  parallel/       device-mesh sharding of the solver (multi-chip)
+  utils/          resource arithmetic, events, metrics, misc
+"""
+
+__version__ = "0.1.0"

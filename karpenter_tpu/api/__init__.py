@@ -1,0 +1,29 @@
+from karpenter_tpu.api import labels
+from karpenter_tpu.api.objects import (
+    Node,
+    NodeClaim,
+    NodePool,
+    NodeSelectorRequirement,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+
+__all__ = [
+    "labels",
+    "Node",
+    "NodeClaim",
+    "NodePool",
+    "NodeSelectorRequirement",
+    "ObjectMeta",
+    "Pod",
+    "PodAffinityTerm",
+    "Taint",
+    "Toleration",
+    "TopologySpreadConstraint",
+    "WeightedPodAffinityTerm",
+]

@@ -1,0 +1,378 @@
+"""Domain objects (the L0 layer): the CRD-equivalent types of the framework.
+
+These correspond to the reference's API types — NodePool
+(/root/reference/pkg/apis/v1/nodepool.go:284), NodeClaim (nodeclaim.go:141) —
+plus the slices of core Kubernetes objects (Pod, Node) the autoscaler consumes.
+They are plain dataclasses: the control plane persists them in an in-memory
+object store (karpenter_tpu.controllers.kube) the way the reference persists CRs
+in the apiserver; the solver consumes them only through the tensor encoder.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import uuid as uuid_mod
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+from karpenter_tpu.utils.resources import ResourceList
+
+# ---------------------------------------------------------------------------
+# metadata
+
+
+_seq = itertools.count()
+
+
+def new_uid() -> str:
+    return str(uuid_mod.UUID(int=(next(_seq) << 64) | uuid_mod.uuid4().int >> 64))
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=new_uid)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    finalizers: list[str] = field(default_factory=list)
+    resource_version: int = 0
+    owner_uid: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# label selection / affinity primitives
+
+
+class Operator(str, Enum):
+    IN = "In"
+    NOT_IN = "NotIn"
+    EXISTS = "Exists"
+    DOES_NOT_EXIST = "DoesNotExist"
+    GT = "Gt"
+    LT = "Lt"
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str
+    operator: Operator
+    values: list[str] = field(default_factory=list)
+    # MinValues: flexibility floor — the minimum number of distinct values the
+    # key must retain across surviving instance types (reference
+    # nodepool.go NodeSelectorRequirementWithMinValues).
+    min_values: Optional[int] = None
+
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str
+    operator: Operator  # In / NotIn / Exists / DoesNotExist
+    values: list[str] = field(default_factory=list)
+
+
+@dataclass
+class LabelSelector:
+    match_labels: dict[str, str] = field(default_factory=dict)
+    match_expressions: list[LabelSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        for expr in self.match_expressions:
+            val = labels.get(expr.key)
+            if expr.operator == Operator.IN:
+                if val is None or val not in expr.values:
+                    return False
+            elif expr.operator == Operator.NOT_IN:
+                if val is not None and val in expr.values:
+                    return False
+            elif expr.operator == Operator.EXISTS:
+                if expr.key not in labels:
+                    return False
+            elif expr.operator == Operator.DOES_NOT_EXIST:
+                if expr.key in labels:
+                    return False
+            else:
+                return False
+        return True
+
+    def is_empty(self) -> bool:
+        return not self.match_labels and not self.match_expressions
+
+
+# ---------------------------------------------------------------------------
+# taints / tolerations
+
+
+class TaintEffect(str, Enum):
+    NO_SCHEDULE = "NoSchedule"
+    PREFER_NO_SCHEDULE = "PreferNoSchedule"
+    NO_EXECUTE = "NoExecute"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    effect: TaintEffect
+    value: str = ""
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""  # empty key + Exists operator tolerates everything
+    operator: str = "Equal"  # "Equal" | "Exists"
+    value: str = ""
+    effect: Optional[TaintEffect] = None  # None matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        """corev1.Toleration.ToleratesTaint semantics."""
+        if self.effect is not None and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+# ---------------------------------------------------------------------------
+# pod scheduling constraints
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: list[NodeSelectorRequirement] = field(default_factory=list)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+
+@dataclass
+class NodeAffinity:
+    # OR across terms; the scheduler takes term[0] and relaxes by dropping it
+    # (reference preferences.go:74 removeRequiredNodeAffinityTerm).
+    required_terms: list[NodeSelectorTerm] = field(default_factory=list)
+    preferred: list[PreferredSchedulingTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAffinityTerm:
+    topology_key: str
+    label_selector: LabelSelector = field(default_factory=LabelSelector)
+    namespaces: list[str] = field(default_factory=list)  # empty = pod's namespace
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int
+    term: PodAffinityTerm
+
+
+class WhenUnsatisfiable(str, Enum):
+    DO_NOT_SCHEDULE = "DoNotSchedule"
+    SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+class NodeInclusionPolicy(str, Enum):
+    HONOR = "Honor"
+    IGNORE = "Ignore"
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: WhenUnsatisfiable = WhenUnsatisfiable.DO_NOT_SCHEDULE
+    label_selector: LabelSelector = field(default_factory=LabelSelector)
+    min_domains: Optional[int] = None
+    node_affinity_policy: NodeInclusionPolicy = NodeInclusionPolicy.HONOR
+    node_taints_policy: NodeInclusionPolicy = NodeInclusionPolicy.IGNORE
+
+
+# ---------------------------------------------------------------------------
+# Pod
+
+
+class PodPhase(str, Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    requests: ResourceList = field(default_factory=dict)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: list[PodAffinityTerm] = field(default_factory=list)
+    pod_affinity_preferred: list[WeightedPodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity: list[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_preferred: list[WeightedPodAffinityTerm] = field(default_factory=list)
+    tolerations: list[Toleration] = field(default_factory=list)
+    topology_spread_constraints: list[TopologySpreadConstraint] = field(default_factory=list)
+    host_ports: list[tuple[str, str, int]] = field(default_factory=list)  # (ip, proto, port)
+    priority: int = 0
+    preemption_policy: str = "PreemptLowerPriority"
+    node_name: str = ""  # bound node
+    phase: PodPhase = PodPhase.PENDING
+    # PVC names used by the pod (volume topology injection; reference
+    # volumetopology.go:51)
+    volume_claims: list[str] = field(default_factory=list)
+    scheduling_gates: list[str] = field(default_factory=list)
+    # Set by the eviction/termination machinery
+    terminating: bool = False
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def deep_copy(self) -> "Pod":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Node
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provider_id: str = ""
+    capacity: ResourceList = field(default_factory=dict)
+    allocatable: ResourceList = field(default_factory=dict)
+    taints: list[Taint] = field(default_factory=list)
+    ready: bool = False
+    unschedulable: bool = False
+    # condition type -> status ("True"/"False"/"Unknown"), for repair policies
+    conditions: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+# ---------------------------------------------------------------------------
+# NodePool / NodeClaim
+
+
+class ConsolidationPolicy(str, Enum):
+    WHEN_EMPTY = "WhenEmpty"
+    WHEN_EMPTY_OR_UNDERUTILIZED = "WhenEmptyOrUnderutilized"
+
+
+@dataclass
+class Budget:
+    """Disruption budget (reference nodepool.go Budget): max concurrently
+    disrupted nodes, expressed as a count or percent, optionally gated to a
+    schedule window and to specific reasons."""
+
+    nodes: str = "10%"  # "<int>" or "<int>%"
+    reasons: list[str] = field(default_factory=list)  # empty = all reasons
+    schedule: Optional[str] = None  # cron expression
+    duration_seconds: Optional[float] = None
+
+
+@dataclass
+class Disruption:
+    consolidation_policy: ConsolidationPolicy = ConsolidationPolicy.WHEN_EMPTY_OR_UNDERUTILIZED
+    consolidate_after_seconds: float = 0.0
+    budgets: list[Budget] = field(default_factory=lambda: [Budget(nodes="10%")])
+
+
+@dataclass
+class NodeClaimTemplateSpec:
+    """The NodeClaim template embedded in a NodePool spec."""
+
+    requirements: list[NodeSelectorRequirement] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    taints: list[Taint] = field(default_factory=list)
+    startup_taints: list[Taint] = field(default_factory=list)
+    node_class_ref: str = "default"
+    expire_after_seconds: Optional[float] = None
+    termination_grace_period_seconds: Optional[float] = None
+
+
+@dataclass
+class NodePool:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    template: NodeClaimTemplateSpec = field(default_factory=NodeClaimTemplateSpec)
+    disruption: Disruption = field(default_factory=Disruption)
+    limits: ResourceList = field(default_factory=dict)
+    weight: int = 0
+    # Static capacity (feature-gated in the reference): fixed replica count
+    replicas: Optional[int] = None
+    # status
+    status_resources: ResourceList = field(default_factory=dict)
+    status_node_count: int = 0
+    conditions: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class NodeClaimStatus:
+    provider_id: str = ""
+    node_name: str = ""
+    image_id: str = ""
+    capacity: ResourceList = field(default_factory=dict)
+    allocatable: ResourceList = field(default_factory=dict)
+    conditions: dict[str, str] = field(default_factory=dict)
+    last_pod_event_time: float = 0.0
+
+
+@dataclass
+class NodeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    requirements: list[NodeSelectorRequirement] = field(default_factory=list)
+    resources_requests: ResourceList = field(default_factory=dict)
+    taints: list[Taint] = field(default_factory=list)
+    startup_taints: list[Taint] = field(default_factory=list)
+    node_class_ref: str = "default"
+    expire_after_seconds: Optional[float] = None
+    termination_grace_period_seconds: Optional[float] = None
+    status: NodeClaimStatus = field(default_factory=NodeClaimStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def nodepool_name(self) -> Optional[str]:
+        from karpenter_tpu.api import labels as l
+
+        return self.metadata.labels.get(l.NODEPOOL_LABEL_KEY)
+
+
+# Status condition types used across controllers (reference apis/v1/*_status.go)
+COND_LAUNCHED = "Launched"
+COND_REGISTERED = "Registered"
+COND_INITIALIZED = "Initialized"
+COND_READY = "Ready"
+COND_DRIFTED = "Drifted"
+COND_EMPTY = "Empty"
+COND_CONSOLIDATABLE = "Consolidatable"
+COND_CONSISTENT_STATE_FOUND = "ConsistentStateFound"
+COND_NODE_REGISTRATION_HEALTHY = "NodeRegistrationHealthy"
+COND_NODE_CLASS_READY = "NodeClassReady"

@@ -1,0 +1,13 @@
+from karpenter_tpu.scheduling.requirements import (
+    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+    Requirement,
+    Requirements,
+)
+from karpenter_tpu.scheduling.taints import Taints
+
+__all__ = [
+    "ALLOW_UNDEFINED_WELL_KNOWN_LABELS",
+    "Requirement",
+    "Requirements",
+    "Taints",
+]

@@ -1,0 +1,68 @@
+from karpenter_tpu.utils import quantity, resources
+
+
+def test_parse_milli():
+    assert quantity.parse("100m") == 100
+    assert quantity.parse("1500m") == 1500
+    assert quantity.parse("0") == 0
+
+
+def test_parse_units():
+    assert quantity.parse("1") == 1000
+    assert quantity.parse("2") == 2000
+    assert quantity.parse(4) == 4000
+    assert quantity.parse("0.5") == 500
+
+
+def test_parse_binary_suffixes():
+    assert quantity.parse("1Ki") == 1024 * 1000
+    assert quantity.parse("2Gi") == 2 * 1024**3 * 1000
+    assert quantity.parse("1.5Gi") == 3 * 1024**3 * 1000 // 2
+    assert quantity.parse("256Mi") == 256 * 1024**2 * 1000
+
+
+def test_parse_decimal_suffixes():
+    assert quantity.parse("1k") == 10**3 * 1000
+    assert quantity.parse("10M") == 10 * 10**6 * 1000
+    assert quantity.parse("1e3") == 10**3 * 1000
+
+
+def test_parse_negative():
+    assert quantity.parse("-1") == -1000
+    assert quantity.parse("-500m") == -500
+
+
+def test_format_roundtrip():
+    for s in ["100m", "1", "2Gi", "256Mi", "10", "1500m"]:
+        assert quantity.parse(quantity.format_milli(quantity.parse(s))) == quantity.parse(s)
+
+
+def test_merge_subtract():
+    a = resources.parse_list({"cpu": "1", "memory": "1Gi"})
+    b = resources.parse_list({"cpu": "500m", "pods": 3})
+    m = resources.merge(a, b)
+    assert m["cpu"] == 1500
+    assert m["pods"] == 3000
+    s = resources.subtract(m, a)
+    assert s["cpu"] == 500
+    assert s["memory"] == 0
+
+
+def test_fits():
+    total = resources.parse_list({"cpu": "4", "memory": "8Gi", "pods": 10})
+    assert resources.fits(resources.parse_list({"cpu": "4"}), total)
+    assert not resources.fits(resources.parse_list({"cpu": "4100m"}), total)
+    # missing resource in total counts as zero
+    assert not resources.fits(resources.parse_list({"fake.com/gpu": 1}), total)
+    # zero-valued request for a missing resource fits
+    assert resources.fits({"fake.com/gpu": 0}, total)
+    # negative total never fits
+    assert not resources.fits({}, {"cpu": -1})
+
+
+def test_max_resources():
+    a = resources.parse_list({"cpu": "1", "memory": "2Gi"})
+    b = resources.parse_list({"cpu": "2", "memory": "1Gi"})
+    m = resources.max_resources(a, b)
+    assert m["cpu"] == 2000
+    assert m["memory"] == quantity.parse("2Gi")

@@ -1,0 +1,245 @@
+"""Requirement/Requirements algebra tests.
+
+Scenario coverage modeled on the reference's requirement/requirements suites
+(/root/reference/pkg/scheduling/requirements_test.go): pairwise operator
+intersection truth tables, bounds interplay, Compatible()'s asymmetric
+undefined-key rule, and pod-requirement construction.
+"""
+
+import itertools
+
+import pytest
+
+from karpenter_tpu.api.objects import (
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Operator,
+    Pod,
+    PreferredSchedulingTerm,
+)
+from karpenter_tpu.scheduling import Requirement, Requirements
+
+IN = lambda key, *vals: Requirement(key, Operator.IN, vals)
+NOT_IN = lambda key, *vals: Requirement(key, Operator.NOT_IN, vals)
+EXISTS = lambda key: Requirement(key, Operator.EXISTS)
+DOES_NOT_EXIST = lambda key: Requirement(key, Operator.DOES_NOT_EXIST)
+GT = lambda key, v: Requirement(key, Operator.GT, [str(v)])
+LT = lambda key, v: Requirement(key, Operator.LT, [str(v)])
+
+
+# -- Requirement.has ---------------------------------------------------------
+
+
+def test_has():
+    assert IN("k", "a", "b").has("a")
+    assert not IN("k", "a", "b").has("c")
+    assert NOT_IN("k", "a").has("b")
+    assert not NOT_IN("k", "a").has("a")
+    assert EXISTS("k").has("anything")
+    assert not DOES_NOT_EXIST("k").has("anything")
+    assert GT("k", 5).has("6")
+    assert not GT("k", 5).has("5")
+    assert not GT("k", 5).has("abc")  # non-integers invalid under bounds
+    assert LT("k", 5).has("4")
+    assert not LT("k", 5).has("5")
+
+
+# -- intersection ------------------------------------------------------------
+
+
+def test_intersection_in_in():
+    r = IN("k", "a", "b").intersection(IN("k", "b", "c"))
+    assert r.values == {"b"} and not r.complement
+
+
+def test_intersection_in_notin():
+    r = IN("k", "a", "b").intersection(NOT_IN("k", "b"))
+    assert r.values == {"a"} and not r.complement
+
+
+def test_intersection_notin_notin():
+    r = NOT_IN("k", "a").intersection(NOT_IN("k", "b"))
+    assert r.complement and r.values == {"a", "b"}
+
+
+def test_intersection_exists_in():
+    r = EXISTS("k").intersection(IN("k", "a"))
+    assert not r.complement and r.values == {"a"}
+
+
+def test_intersection_doesnotexist():
+    r = DOES_NOT_EXIST("k").intersection(IN("k", "a"))
+    assert not r.complement and r.values == set()
+
+
+def test_intersection_bounds():
+    r = GT("k", 1).intersection(LT("k", 5))
+    assert r.complement and r.greater_than == 1 and r.less_than == 5
+    assert r.has("3") and not r.has("1") and not r.has("5")
+    # contradictory bounds collapse to DoesNotExist
+    r2 = GT("k", 5).intersection(LT("k", 3))
+    assert r2.operator() == Operator.DOES_NOT_EXIST
+    # bounds filter concrete values and are then dropped
+    r3 = IN("k", "1", "3", "9").intersection(GT("k", 2))
+    assert r3.values == {"3", "9"} and not r3.complement
+    assert r3.greater_than is None  # dropped for concrete sets
+
+
+def test_intersection_min_values_max_wins():
+    a = Requirement("k", Operator.IN, ["a", "b"], min_values=1)
+    b = Requirement("k", Operator.IN, ["a", "b"], min_values=2)
+    assert a.intersection(b).min_values == 2
+
+
+# -- has_intersection agrees with intersection non-emptiness -----------------
+
+
+def _nonempty(r: Requirement) -> bool:
+    if r.complement:
+        # a complement is non-empty iff its integer bounds window is non-empty
+        if r.greater_than is not None and r.less_than is not None:
+            return r.greater_than < r.less_than
+        return True
+    return len(r.values) > 0
+
+
+@pytest.mark.parametrize(
+    "a,b",
+    list(
+        itertools.product(
+            [
+                IN("k", "a"),
+                IN("k", "a", "b"),
+                IN("k", "1", "7"),
+                NOT_IN("k", "a"),
+                NOT_IN("k", "1"),
+                EXISTS("k"),
+                DOES_NOT_EXIST("k"),
+                GT("k", 3),
+                LT("k", 5),
+                GT("k", 8),
+            ],
+            repeat=2,
+        )
+    ),
+)
+def test_has_intersection_matches_intersection(a, b):
+    # Mirrors the reference's property: HasIntersection is the allocation-free
+    # equivalent of Intersection + emptiness check (requirement.go:194-197),
+    # EXCEPT both-complement cases where the reference returns true without
+    # value checks — replicate exactly.
+    got = a.has_intersection(b)
+    if a.complement and b.complement:
+        gt = max((v for v in [a.greater_than, b.greater_than] if v is not None), default=None)
+        lt = min((v for v in [a.less_than, b.less_than] if v is not None), default=None)
+        expected = not (gt is not None and lt is not None and gt >= lt)
+    else:
+        expected = _nonempty(a.intersection(b))
+    assert got == expected, f"{a!r} ∩ {b!r}"
+
+
+# -- Requirements map --------------------------------------------------------
+
+
+def test_add_auto_intersects():
+    reqs = Requirements([IN("k", "a", "b")])
+    reqs.add(IN("k", "b", "c"))
+    assert reqs.get("k").values == {"b"}
+
+
+def test_get_default_exists():
+    reqs = Requirements()
+    assert reqs.get("missing").operator() == Operator.EXISTS
+
+
+def test_label_normalization():
+    r = Requirement("beta.kubernetes.io/arch", Operator.IN, ["amd64"])
+    assert r.key == "kubernetes.io/arch"
+
+
+def test_intersects_overlap():
+    a = Requirements([IN("k", "a", "b")])
+    b = Requirements([IN("k", "b", "c")])
+    assert a.intersects(b) is None
+    c = Requirements([IN("k", "x")])
+    assert a.intersects(c) is not None
+
+
+def test_intersects_undefined_keys_allowed():
+    a = Requirements([IN("k1", "a")])
+    b = Requirements([IN("k2", "b")])
+    assert a.intersects(b) is None
+
+
+def test_intersects_notin_vs_notin_tolerated():
+    # DoesNotExist incoming vs NotIn existing with no overlap is tolerated
+    # (requirements.go:253-259)
+    a = Requirements([DOES_NOT_EXIST("k")])
+    b = Requirements([NOT_IN("k", "a")])
+    assert b.intersects(a) is None
+
+
+def test_compatible_custom_label_must_be_defined():
+    node = Requirements([IN("known", "x")])
+    pod = Requirements([IN("custom-key", "x")])
+    # custom label undefined on node -> error
+    assert node.compatible(pod) is not None
+    # but allowed when listed in allow_undefined
+    assert node.compatible(pod, allow_undefined={"custom-key"}) is None
+    # NotIn/DoesNotExist incoming ops don't require definition
+    assert node.compatible(Requirements([NOT_IN("custom-key", "v")])) is None
+    assert node.compatible(Requirements([DOES_NOT_EXIST("custom-key")])) is None
+
+
+def test_compatible_well_known_may_be_undefined():
+    from karpenter_tpu.api import labels as wk
+
+    node = Requirements()
+    pod = Requirements([IN(wk.TOPOLOGY_ZONE_LABEL_KEY, "zone-1")])
+    assert node.compatible(pod, allow_undefined=wk.WELL_KNOWN_LABELS) is None
+
+
+def test_pod_requirements_construction():
+    pod = Pod(
+        node_selector={"disk": "ssd"},
+        node_affinity=NodeAffinity(
+            required_terms=[
+                NodeSelectorTerm([NodeSelectorRequirement("zone", Operator.IN, ["a", "b"])]),
+                NodeSelectorTerm([NodeSelectorRequirement("zone", Operator.IN, ["c"])]),
+            ],
+            preferred=[
+                PreferredSchedulingTerm(
+                    weight=10,
+                    preference=NodeSelectorTerm(
+                        [NodeSelectorRequirement("arch", Operator.IN, ["amd64"])]
+                    ),
+                ),
+                PreferredSchedulingTerm(
+                    weight=1,
+                    preference=NodeSelectorTerm(
+                        [NodeSelectorRequirement("os", Operator.IN, ["linux"])]
+                    ),
+                ),
+            ],
+        ),
+    )
+    reqs = Requirements.from_pod(pod)
+    assert reqs.get("disk").values == {"ssd"}
+    # only the first required term
+    assert reqs.get("zone").values == {"a", "b"}
+    # only the heaviest preference
+    assert reqs.get("arch").values == {"amd64"}
+    assert not reqs.has("os")
+    # strict ignores preferences
+    strict = Requirements.strict_from_pod(pod)
+    assert not strict.has("arch")
+    assert strict.get("zone").values == {"a", "b"}
+
+
+def test_requirement_len():
+    import sys
+
+    assert len(IN("k", "a", "b")) == 2
+    assert len(NOT_IN("k", "a")) == sys.maxsize - 1
+    assert len(DOES_NOT_EXIST("k")) == 0

@@ -1,0 +1,56 @@
+from karpenter_tpu.api.objects import Pod, Taint, TaintEffect, Toleration
+from karpenter_tpu.scheduling import Taints
+
+
+def taint(key, value="", effect=TaintEffect.NO_SCHEDULE):
+    return Taint(key, effect, value)
+
+
+def test_no_taints_tolerates_all():
+    assert Taints().tolerates_pod(Pod()) is None
+
+
+def test_untolerated_taint():
+    ts = Taints([taint("gpu", "true")])
+    assert ts.tolerates_pod(Pod()) is not None
+
+
+def test_equal_toleration():
+    ts = Taints([taint("gpu", "true")])
+    pod = Pod(tolerations=[Toleration(key="gpu", operator="Equal", value="true")])
+    assert ts.tolerates_pod(pod) is None
+    pod_wrong = Pod(tolerations=[Toleration(key="gpu", operator="Equal", value="false")])
+    assert ts.tolerates_pod(pod_wrong) is not None
+
+
+def test_exists_toleration():
+    ts = Taints([taint("gpu", "true")])
+    pod = Pod(tolerations=[Toleration(key="gpu", operator="Exists")])
+    assert ts.tolerates_pod(pod) is None
+
+
+def test_empty_key_exists_tolerates_everything():
+    ts = Taints([taint("a"), taint("b", effect=TaintEffect.NO_EXECUTE)])
+    pod = Pod(tolerations=[Toleration(operator="Exists")])
+    assert ts.tolerates_pod(pod) is None
+
+
+def test_effect_scoping():
+    ts = Taints([taint("a", effect=TaintEffect.NO_EXECUTE)])
+    pod = Pod(tolerations=[Toleration(key="a", operator="Exists", effect=TaintEffect.NO_SCHEDULE)])
+    assert ts.tolerates_pod(pod) is not None
+
+
+def test_prefer_no_schedule_is_hard_until_relaxed():
+    # The scheduler treats PreferNoSchedule as a hard constraint; the
+    # relaxation ladder adds a toleration later (reference preferences.go:140).
+    ts = Taints([taint("a", effect=TaintEffect.PREFER_NO_SCHEDULE)])
+    assert ts.tolerates_pod(Pod()) is not None
+    pod = Pod(tolerations=[Toleration(operator="Exists", effect=TaintEffect.PREFER_NO_SCHEDULE)])
+    assert ts.tolerates_pod(pod) is None
+
+
+def test_merge_keyed_by_key_and_effect():
+    ts = Taints([taint("a")])
+    merged = ts.merge([taint("a", "different-value"), taint("b")])
+    assert len(merged) == 2  # "a"/NoSchedule already present, "b" added
