@@ -1,0 +1,27 @@
+from karpenter_tpu.cloudprovider.types import (
+    CloudProvider,
+    CreateError,
+    InstanceType,
+    InstanceTypeOverhead,
+    InstanceTypes,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    NodeClassNotReadyError,
+    Offering,
+    Offerings,
+    RepairPolicy,
+)
+
+__all__ = [
+    "CloudProvider",
+    "CreateError",
+    "InstanceType",
+    "InstanceTypeOverhead",
+    "InstanceTypes",
+    "InsufficientCapacityError",
+    "NodeClaimNotFoundError",
+    "NodeClassNotReadyError",
+    "Offering",
+    "Offerings",
+    "RepairPolicy",
+]

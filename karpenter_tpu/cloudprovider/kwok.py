@@ -1,0 +1,132 @@
+"""KWOK-style simulated cloud: a generated instance-type universe and (in
+karpenter_tpu.controllers) a provider that fabricates Node objects directly —
+no kubelet, no cloud API — so the full provision->schedule->consolidate loop
+runs self-contained (reference /root/reference/kwok/ and
+designs/kwok-provider.md).
+
+Universe: 12 sizes x 3 families x 2 OS x 2 arch = 288 instance types, each
+offered in 4 zones x {spot, on-demand} (kwok/tools/gen_instance_types.go:70-110).
+Pricing: base = vCPU*0.025 + GiB*0.001, spot = 0.7x (designs/kwok-provider.md:44-56).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import Operator
+from karpenter_tpu.cloudprovider.types import (
+    InstanceType,
+    InstanceTypeOverhead,
+    InstanceTypes,
+    Offering,
+    Offerings,
+)
+from karpenter_tpu.scheduling import Requirement, Requirements
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.quantity import parse as q
+
+KWOK_GROUP = "karpenter.kwok.sh"
+INSTANCE_SIZE_LABEL_KEY = f"{KWOK_GROUP}/instance-size"
+INSTANCE_FAMILY_LABEL_KEY = f"{KWOK_GROUP}/instance-family"
+INSTANCE_MEMORY_LABEL_KEY = f"{KWOK_GROUP}/instance-memory"
+INSTANCE_CPU_LABEL_KEY = f"{KWOK_GROUP}/instance-cpu"
+
+well_known.WELL_KNOWN_LABELS.update(
+    {
+        INSTANCE_SIZE_LABEL_KEY,
+        INSTANCE_FAMILY_LABEL_KEY,
+        INSTANCE_MEMORY_LABEL_KEY,
+        INSTANCE_CPU_LABEL_KEY,
+    }
+)
+
+KWOK_ZONES = ["test-zone-a", "test-zone-b", "test-zone-c", "test-zone-d"]
+KWOK_SIZES = [1, 2, 4, 8, 16, 32, 48, 64, 96, 128, 192, 256]
+# family -> GiB per vCPU (designs/kwok-provider.md:19-23)
+KWOK_FAMILIES = {"c": 2, "s": 4, "m": 8}
+
+# The partition label KWOK nodes are spread over (kwok provider adds
+# kwok-partition labels for simulated topology).
+PARTITION_LABEL_KEY = f"{KWOK_GROUP}/partition"
+
+
+def price_from_resources(resources: res.ResourceList) -> float:
+    """kwok/tools/gen_instance_types.go:54 priceFromResources."""
+    price = 0.0
+    for name, millis in resources.items():
+        if name == res.CPU:
+            price += 0.025 * millis / 1000
+        elif name == res.MEMORY:
+            price += 0.001 * (millis / 1000) / 1e9
+    return price
+
+
+def construct_instance_types(
+    zones: Optional[list[str]] = None,
+    sizes: Optional[list[int]] = None,
+    families: Optional[dict[str, int]] = None,
+    oses: tuple[str, ...] = ("linux", "windows"),
+    arches: tuple[str, ...] = ("amd64", "arm64"),
+) -> InstanceTypes:
+    """The KWOK instance universe (kwok/tools/gen_instance_types.go:69-110 +
+    kwok/cloudprovider/helpers.go:120-200 newInstanceType)."""
+    zones = zones if zones is not None else KWOK_ZONES
+    sizes = sizes if sizes is not None else KWOK_SIZES
+    families = families if families is not None else KWOK_FAMILIES
+    out = InstanceTypes()
+    for cpu, (family, mem_factor), os_, arch in itertools.product(
+        sizes, families.items(), oses, arches
+    ):
+        mem = cpu * mem_factor
+        pods = min(cpu * 16, 1024)
+        name = f"{family}-{cpu}x-{arch}-{os_}"
+        resources = {
+            res.CPU: q(str(cpu)),
+            res.MEMORY: q(f"{mem}Gi"),
+            res.PODS: q(str(pods)),
+            res.EPHEMERAL_STORAGE: q("20Gi"),
+        }
+        price = price_from_resources(resources)
+        offerings = Offerings(
+            Offering(
+                requirements=Requirements.from_labels(
+                    {
+                        well_known.CAPACITY_TYPE_LABEL_KEY: ct,
+                        well_known.TOPOLOGY_ZONE_LABEL_KEY: zone,
+                    }
+                ),
+                price=price * 0.7 if ct == "spot" else price,
+                available=True,
+            )
+            for zone in zones
+            for ct in ("spot", "on-demand")
+        )
+        requirements = Requirements(
+            [
+                Requirement(well_known.INSTANCE_TYPE_LABEL_KEY, Operator.IN, [name]),
+                Requirement(well_known.ARCH_LABEL_KEY, Operator.IN, [arch]),
+                Requirement(well_known.OS_LABEL_KEY, Operator.IN, [os_]),
+                Requirement(well_known.TOPOLOGY_ZONE_LABEL_KEY, Operator.IN, zones),
+                Requirement(
+                    well_known.CAPACITY_TYPE_LABEL_KEY, Operator.IN, ["spot", "on-demand"]
+                ),
+                Requirement(INSTANCE_SIZE_LABEL_KEY, Operator.IN, [f"{cpu}x"]),
+                Requirement(INSTANCE_FAMILY_LABEL_KEY, Operator.IN, [family]),
+                Requirement(INSTANCE_CPU_LABEL_KEY, Operator.IN, [str(cpu)]),
+                Requirement(INSTANCE_MEMORY_LABEL_KEY, Operator.IN, [str(mem * 1024)]),
+            ]
+        )
+        out.append(
+            InstanceType(
+                name=name,
+                requirements=requirements,
+                offerings=offerings,
+                capacity=resources,
+                overhead=InstanceTypeOverhead(
+                    kube_reserved=res.parse_list({res.CPU: "100m", res.MEMORY: "120Mi"})
+                ),
+            )
+        )
+    return out

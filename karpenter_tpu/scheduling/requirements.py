@@ -22,6 +22,11 @@ from __future__ import annotations
 
 import random
 import sys
+
+# Seeded module-level RNG so representative values for complement requirements
+# (any_value on NotIn/Exists/Gt/Lt) are deterministic across identical runs —
+# required for bit-identical oracle-vs-TPU comparisons.
+_any_rng = random.Random(0x5EED)
 from typing import Iterable, Iterator, Mapping, Optional
 
 from karpenter_tpu.api import labels as well_known
@@ -197,7 +202,7 @@ class Requirement:
             if lo >= hi:
                 return ""
             for _ in range(100):
-                candidate = str(random.randrange(lo, hi))
+                candidate = str(_any_rng.randrange(lo, hi))
                 if candidate not in self.values:
                     return candidate
         return ""

@@ -1,0 +1,15 @@
+"""The scheduling core (L4): the first-fit-decreasing oracle and the batched
+TPU solver behind a common interface.
+
+- `oracle`: sequential reference implementation replicating the Go scheduler
+  (/root/reference/pkg/controllers/provisioning/scheduling/scheduler.go). It is
+  the correctness referee for the TPU kernels and the CPU baseline for
+  benchmarks.
+- `topology`: topology-spread / pod-affinity / anti-affinity tracking.
+- `tpu`: the batched JAX solver (see karpenter_tpu.ops for the kernels).
+"""
+
+from karpenter_tpu.solver.oracle import Results, Scheduler, SchedulerOptions
+from karpenter_tpu.solver.topology import Topology
+
+__all__ = ["Results", "Scheduler", "SchedulerOptions", "Topology"]

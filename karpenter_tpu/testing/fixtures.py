@@ -1,0 +1,272 @@
+"""Object factories and benchmark workload generators.
+
+The factories mirror the reference's test fixture package
+(/root/reference/pkg/test/pods.go et al.); the pod-mix generators replicate the
+scheduling benchmark harness exactly — same five pod classes, same discrete
+CPU/memory/label-value distributions — so throughput numbers are comparable
+with the reference benchmark
+(/root/reference/pkg/controllers/provisioning/scheduling/
+scheduling_benchmark_test.go:257-453).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import (
+    Budget,
+    Disruption,
+    LabelSelector,
+    NodeAffinity,
+    NodeClaimTemplateSpec,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Operator,
+    Pod,
+    PodAffinityTerm,
+    PreferredSchedulingTerm,
+    NodePool,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+    WhenUnsatisfiable,
+)
+from karpenter_tpu.utils import resources as res
+
+# Seeded like the reference benchmark (scheduling_benchmark_test.go:62)
+_rng = random.Random(42)
+
+
+def reset_rng(seed: int = 42) -> None:
+    global _rng
+    _rng = random.Random(seed)
+
+
+# ---------------------------------------------------------------------------
+# factories
+
+
+def pod(
+    name: str = "",
+    namespace: str = "default",
+    labels: Optional[dict[str, str]] = None,
+    requests: Optional[dict[str, str | int]] = None,
+    node_selector: Optional[dict[str, str]] = None,
+    node_requirements: Optional[list[NodeSelectorRequirement]] = None,
+    node_preferences: Optional[list[NodeSelectorRequirement]] = None,
+    pod_requirements: Optional[list[PodAffinityTerm]] = None,
+    pod_preferences: Optional[list[WeightedPodAffinityTerm]] = None,
+    pod_anti_requirements: Optional[list[PodAffinityTerm]] = None,
+    pod_anti_preferences: Optional[list[WeightedPodAffinityTerm]] = None,
+    topology_spread_constraints: Optional[list[TopologySpreadConstraint]] = None,
+    tolerations: Optional[list[Toleration]] = None,
+    creation_timestamp: float = 0.0,
+) -> Pod:
+    """test.Pod(test.PodOptions{...}) equivalent (reference pkg/test/pods.go)."""
+    meta = ObjectMeta(
+        name=name or f"pod-{ObjectMeta().uid[:8]}",
+        namespace=namespace,
+        labels=dict(labels or {}),
+        creation_timestamp=creation_timestamp,
+    )
+    node_affinity = None
+    if node_requirements or node_preferences:
+        node_affinity = NodeAffinity(
+            required_terms=(
+                [NodeSelectorTerm(list(node_requirements))] if node_requirements else []
+            ),
+            preferred=(
+                [
+                    PreferredSchedulingTerm(weight=10, preference=NodeSelectorTerm([p]))
+                    for p in node_preferences
+                ]
+                if node_preferences
+                else []
+            ),
+        )
+    return Pod(
+        metadata=meta,
+        requests=res.parse_list(requests or {}),
+        node_selector=dict(node_selector or {}),
+        node_affinity=node_affinity,
+        pod_affinity=list(pod_requirements or []),
+        pod_affinity_preferred=list(pod_preferences or []),
+        pod_anti_affinity=list(pod_anti_requirements or []),
+        pod_anti_affinity_preferred=list(pod_anti_preferences or []),
+        tolerations=list(tolerations or []),
+        topology_spread_constraints=list(topology_spread_constraints or []),
+    )
+
+
+def node_pool(
+    name: str = "default",
+    requirements: Optional[list[NodeSelectorRequirement]] = None,
+    labels: Optional[dict[str, str]] = None,
+    taints: Optional[list[Taint]] = None,
+    limits: Optional[dict[str, str | int]] = None,
+    weight: int = 0,
+    consolidate_after_seconds: float = 0.0,
+    budgets: Optional[list[Budget]] = None,
+    replicas: Optional[int] = None,
+) -> NodePool:
+    """test.NodePool equivalent: defaults mirror pkg/test/nodepool.go (default
+    requirements allow linux + amd64/arm64 + on-demand/spot)."""
+    reqs = requirements if requirements is not None else []
+    return NodePool(
+        metadata=ObjectMeta(name=name),
+        template=NodeClaimTemplateSpec(
+            requirements=list(reqs),
+            labels=dict(labels or {}),
+            taints=list(taints or []),
+        ),
+        disruption=Disruption(
+            consolidate_after_seconds=consolidate_after_seconds,
+            budgets=budgets if budgets is not None else [Budget(nodes="10%")],
+        ),
+        limits=res.parse_list(limits or {}),
+        weight=weight,
+        replicas=replicas,
+    )
+
+
+# ---------------------------------------------------------------------------
+# benchmark pod mixes (scheduling_benchmark_test.go:257-453)
+
+_LABEL_VALUES = ["a", "b", "c", "d", "e", "f", "g"]
+_MEM_CHOICES = [100, 256, 512, 1024, 2048, 4096]  # Mi
+_CPU_CHOICES = [100, 250, 500, 1000, 1500]  # m
+
+
+def _random_labels() -> dict[str, str]:
+    return {"my-label": _rng.choice(_LABEL_VALUES)}
+
+
+def _random_affinity_labels() -> dict[str, str]:
+    return {"my-affininity": _rng.choice(_LABEL_VALUES)}  # [sic] reference typo
+
+
+def _random_requests() -> dict[str, str]:
+    return {
+        res.CPU: f"{_rng.choice(_CPU_CHOICES)}m",
+        res.MEMORY: f"{_rng.choice(_MEM_CHOICES)}Mi",
+    }
+
+
+def make_generic_pods(count: int) -> list[Pod]:
+    return [
+        pod(name=f"generic-{i}", labels=_random_labels(), requests=_random_requests())
+        for i in range(count)
+    ]
+
+
+def make_topology_spread_pods(count: int, key: str) -> list[Pod]:
+    return [
+        pod(
+            name=f"tsc-{key.rsplit('/', 1)[-1]}-{i}",
+            labels=_random_labels(),
+            requests=_random_requests(),
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=key,
+                    when_unsatisfiable=WhenUnsatisfiable.DO_NOT_SCHEDULE,
+                    label_selector=LabelSelector(match_labels=_random_labels()),
+                )
+            ],
+        )
+        for i in range(count)
+    ]
+
+
+def make_pod_affinity_pods(count: int, key: str) -> list[Pod]:
+    out = []
+    for i in range(count):
+        # self-affinity, as in the reference (benchmark_test.go:300-327)
+        labels = _random_affinity_labels()
+        out.append(
+            pod(
+                name=f"aff-{i}",
+                labels=labels,
+                requests=_random_requests(),
+                pod_requirements=[
+                    PodAffinityTerm(
+                        topology_key=key,
+                        label_selector=LabelSelector(match_labels=dict(labels)),
+                    )
+                ],
+            )
+        )
+    return out
+
+
+def make_pod_anti_affinity_pods(count: int, key: str) -> list[Pod]:
+    # all of these pods have anti-affinity to each other
+    labels = {"app": "nginx"}
+    return [
+        pod(
+            name=f"anti-{i}",
+            labels=dict(labels),
+            requests=_random_requests(),
+            pod_anti_requirements=[
+                PodAffinityTerm(
+                    topology_key=key,
+                    label_selector=LabelSelector(match_labels=dict(labels)),
+                )
+            ],
+        )
+        for i in range(count)
+    ]
+
+
+def make_diverse_pods(count: int) -> list[Pod]:
+    """makeDiversePods: five equal classes — generic, zonal TSC, hostname TSC,
+    zonal self-affinity, hostname anti-affinity — padded with generics."""
+    n = count // 5
+    pods: list[Pod] = []
+    pods += make_generic_pods(n)
+    pods += make_topology_spread_pods(n, well_known.TOPOLOGY_ZONE_LABEL_KEY)
+    pods += make_topology_spread_pods(n, well_known.HOSTNAME_LABEL_KEY)
+    pods += make_pod_affinity_pods(n, well_known.TOPOLOGY_ZONE_LABEL_KEY)
+    pods += make_pod_anti_affinity_pods(n, well_known.HOSTNAME_LABEL_KEY)
+    pods += make_generic_pods(count - len(pods))
+    return pods
+
+
+def make_preference_pods(count: int) -> list[Pod]:
+    """makePreferencePods: one satisfiable node preference + one unsatisfiable
+    and one satisfiable pod-anti preference (benchmark_test.go:378-426)."""
+    out = []
+    for i in range(count):
+        out.append(
+            pod(
+                name=f"pref-{i}",
+                labels={"app": "nginx"},
+                requests=_random_requests(),
+                node_preferences=[
+                    NodeSelectorRequirement(
+                        well_known.TOPOLOGY_ZONE_LABEL_KEY, Operator.IN, ["test-zone-1"]
+                    )
+                ],
+                pod_anti_preferences=[
+                    WeightedPodAffinityTerm(
+                        weight=10,
+                        term=PodAffinityTerm(
+                            topology_key=well_known.TOPOLOGY_ZONE_LABEL_KEY,
+                            label_selector=LabelSelector(match_labels={"app": "nginx"}),
+                        ),
+                    ),
+                    WeightedPodAffinityTerm(
+                        weight=1,
+                        term=PodAffinityTerm(
+                            topology_key=well_known.HOSTNAME_LABEL_KEY,
+                            label_selector=LabelSelector(match_labels={"app": "nginx"}),
+                        ),
+                    ),
+                ],
+            )
+        )
+    return out

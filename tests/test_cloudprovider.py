@@ -1,0 +1,175 @@
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodeClaim, NodeSelectorRequirement, ObjectMeta, Operator
+from karpenter_tpu.cloudprovider import fake, kwok
+from karpenter_tpu.cloudprovider.types import InstanceTypes, NodeClaimNotFoundError
+from karpenter_tpu.scheduling import Requirement, Requirements
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.quantity import parse as q
+
+import pytest
+
+
+def test_fake_instance_types_shape():
+    its = fake.instance_types(400)
+    assert len(its) == 400
+    it0 = its[0]
+    assert it0.capacity[res.CPU] == q("1")
+    assert it0.capacity[res.MEMORY] == q("2Gi")
+    assert it0.capacity[res.PODS] == q("10")
+    assert len(it0.offerings) == 5
+    # allocatable subtracts kube-reserved overhead
+    assert it0.allocatable()[res.CPU] == q("1") - q("100m")
+    assert it0.allocatable()[res.MEMORY] == q("2Gi") - q("10Mi")
+    # requirements carry zone/capacity-type/integer labels
+    assert it0.requirements.get(wk.TOPOLOGY_ZONE_LABEL_KEY).values == {
+        "test-zone-1",
+        "test-zone-2",
+        "test-zone-3",
+    }
+    assert it0.requirements.get(fake.INTEGER_INSTANCE_LABEL_KEY).values == {"1"}
+    assert it0.requirements.get(fake.LABEL_INSTANCE_SIZE).values == {"small"}
+    # a big one is large/exotic
+    big = its[10]
+    assert big.requirements.get(fake.LABEL_INSTANCE_SIZE).values == {"large"}
+    assert big.requirements.get(fake.EXOTIC_INSTANCE_LABEL_KEY).values == {"optional"}
+
+
+def test_fake_labels_registered_well_known():
+    assert fake.LABEL_INSTANCE_SIZE in wk.WELL_KNOWN_LABELS
+    assert fake.INTEGER_INSTANCE_LABEL_KEY in wk.WELL_KNOWN_LABELS
+
+
+def test_kwok_universe():
+    its = kwok.construct_instance_types()
+    assert len(its) == 12 * 3 * 2 * 2  # sizes x families x os x arch
+    by_name = {it.name: it for it in its}
+    c1 = by_name["c-1x-amd64-linux"]
+    assert c1.capacity[res.MEMORY] == q("2Gi")
+    s4 = by_name["s-4x-arm64-windows"]
+    assert s4.capacity[res.MEMORY] == q("16Gi")
+    m256 = by_name["m-256x-amd64-linux"]
+    assert m256.capacity[res.MEMORY] == q("2048Gi")
+    assert m256.capacity[res.PODS] == q("1024")  # clamped
+    # 4 zones x 2 capacity types offerings
+    assert len(c1.offerings) == 8
+    # spot is 0.7x on-demand
+    spot = [o for o in c1.offerings if o.capacity_type() == "spot"][0]
+    od = [o for o in c1.offerings if o.capacity_type() == "on-demand"][0]
+    assert abs(spot.price - 0.7 * od.price) < 1e-9
+    # price formula: 1 vCPU * 0.025 + 2 GiB * 0.001 * (1024^3/1e9)
+    assert abs(od.price - (0.025 + 0.001 * 2 * 1024**3 / 1e9)) < 1e-9
+
+
+def test_order_by_price():
+    its = fake.instance_types(10)
+    reqs = Requirements()
+    its_sorted = InstanceTypes(list(its)).order_by_price(reqs)
+    prices = [
+        min(o.price for o in it.offerings) for it in its_sorted
+    ]
+    assert prices == sorted(prices)
+    # restricting to an offering-less zone pushes everything to +inf, order stable
+    reqs_zone = Requirements([Requirement(wk.TOPOLOGY_ZONE_LABEL_KEY, Operator.IN, ["nope"])])
+    InstanceTypes(list(its)).order_by_price(reqs_zone)
+
+
+def test_satisfies_min_values():
+    its = InstanceTypes(fake.instance_types(5))
+    reqs = Requirements(
+        [
+            Requirement(
+                wk.INSTANCE_TYPE_LABEL_KEY,
+                Operator.IN,
+                [f"fake-it-{i}" for i in range(5)],
+                min_values=3,
+            )
+        ]
+    )
+    needed, unsat, err = its.satisfies_min_values(reqs)
+    assert err is None and needed == 3 and not unsat
+    reqs_too_many = Requirements(
+        [
+            Requirement(
+                wk.INSTANCE_TYPE_LABEL_KEY,
+                Operator.IN,
+                [f"fake-it-{i}" for i in range(5)],
+                min_values=9,
+            )
+        ]
+    )
+    needed, unsat, err = its.satisfies_min_values(reqs_too_many)
+    assert err is not None and unsat == {wk.INSTANCE_TYPE_LABEL_KEY: 5}
+
+
+def test_truncate_respects_min_values():
+    its = InstanceTypes(fake.instance_types(10))
+    reqs = Requirements(
+        [
+            Requirement(
+                wk.INSTANCE_TYPE_LABEL_KEY,
+                Operator.IN,
+                [f"fake-it-{i}" for i in range(10)],
+                min_values=5,
+            )
+        ]
+    )
+    truncated, err = its.truncate(reqs, max_items=6)
+    assert err is None and len(truncated) == 6
+    _, err2 = its.truncate(reqs, max_items=3)
+    assert err2 is not None  # 3 < minValues 5
+    # best-effort policy allows the violation
+    truncated3, err3 = its.truncate(reqs, max_items=3, best_effort_min_values=True)
+    assert err3 is None and len(truncated3) == 3
+
+
+def _claim(requirements=None, pool="default"):
+    nc = NodeClaim(
+        metadata=ObjectMeta(name="test-claim", labels={wk.NODEPOOL_LABEL_KEY: pool}),
+        requirements=requirements or [],
+    )
+    return nc
+
+
+def test_fake_provider_create_picks_cheapest_compatible():
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    created = cp.create(
+        _claim(
+            requirements=[
+                NodeSelectorRequirement(fake.INTEGER_INSTANCE_LABEL_KEY, Operator.IN, ["4"])
+            ]
+        )
+    )
+    assert created.metadata.labels[wk.INSTANCE_TYPE_LABEL_KEY] == "fake-it-3"
+    assert created.status.provider_id.startswith("fake:///fake-it-3/")
+    assert cp.get(created.status.provider_id) is created
+    assert len(cp.list()) == 1
+    cp.delete(created)
+    with pytest.raises(NodeClaimNotFoundError):
+        cp.get(created.status.provider_id)
+
+
+def test_fake_provider_injected_error():
+    cp = fake.FakeCloudProvider()
+    cp.next_create_err = RuntimeError("boom")
+    with pytest.raises(RuntimeError):
+        cp.create(_claim())
+    # error is one-shot
+    cp.create(_claim())
+
+
+def test_benchmark_pod_mixes():
+    from karpenter_tpu import testing as fixtures
+
+    fixtures.reset_rng()
+    pods = fixtures.make_diverse_pods(100)
+    assert len(pods) == 100
+    tsc = [p for p in pods if p.topology_spread_constraints]
+    aff = [p for p in pods if p.pod_affinity]
+    anti = [p for p in pods if p.pod_anti_affinity]
+    assert len(tsc) == 40 and len(aff) == 20 and len(anti) == 20
+    for p in pods:
+        assert p.requests[res.CPU] in {100, 250, 500, 1000, 1500}
+        assert p.requests[res.MEMORY] % q("1Mi") == 0
+    prefs = fixtures.make_preference_pods(10)
+    assert all(p.node_affinity.preferred for p in prefs)
+    assert all(len(p.pod_anti_affinity_preferred) == 2 for p in prefs)

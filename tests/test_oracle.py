@@ -1,0 +1,343 @@
+"""Oracle scheduler behavior tests.
+
+Scenarios modeled on the reference's scheduler suite
+(/root/reference/pkg/controllers/provisioning/scheduling/suite_test.go and
+topology_test.go): resource packing, node selectors, taints, topology spread,
+pod (anti-)affinity, preference relaxation, nodepool limits/weights, existing
+nodes.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import (
+    LabelSelector,
+    NodeSelectorRequirement,
+    Operator,
+    Taint,
+    TaintEffect,
+    Toleration,
+    TopologySpreadConstraint,
+    WhenUnsatisfiable,
+)
+from karpenter_tpu.cloudprovider import fake
+from karpenter_tpu.cloudprovider.types import InstanceTypes
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.solver.nodes import StateNodeView
+from karpenter_tpu.solver.oracle import Scheduler, SchedulerOptions
+from karpenter_tpu.solver.topology import Topology
+from karpenter_tpu.testing import fixtures
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.quantity import parse as q
+
+
+def build(pods, node_pools=None, instance_types=None, state_nodes=None, options=None):
+    node_pools = node_pools or [fixtures.node_pool()]
+    its = instance_types if instance_types is not None else fake.instance_types(20)
+    by_pool = {np.name: InstanceTypes(its) for np in node_pools}
+    topology = Topology(
+        node_pools,
+        by_pool,
+        pods,
+        state_node_views=state_nodes or [],
+        ignore_preferences=bool(options and options.ignore_preferences),
+    )
+    return Scheduler(
+        node_pools,
+        by_pool,
+        topology,
+        state_nodes=state_nodes,
+        options=options,
+    )
+
+
+def test_single_pod_gets_a_node():
+    pods = [fixtures.pod(requests={"cpu": "1"})]
+    results = build(pods).solve(pods)
+    assert results.all_pods_scheduled()
+    assert len(results.new_node_claims) == 1
+    claim = results.new_node_claims[0]
+    assert len(claim.pods) == 1
+    # hostname was stripped at finalize
+    assert not claim.requirements.has(wk.HOSTNAME_LABEL_KEY)
+
+
+def test_resource_packing_binpacks():
+    fixtures.reset_rng()
+    pods = [fixtures.pod(requests={"cpu": "1"}) for _ in range(30)]
+    results = build(pods).solve(pods)
+    assert results.all_pods_scheduled()
+    # pods-per-node resource cap: fake-it-N has N+1 cpu and 10(N+1) pods; the
+    # bin-packer should use far fewer than 30 nodes
+    assert len(results.new_node_claims) < 10
+    # accumulated requests never exceed the largest surviving instance type
+    for claim in results.new_node_claims:
+        for it in claim.instance_type_options:
+            assert res.fits(claim.requests, it.allocatable())
+
+
+def test_too_big_pod_fails_with_reason():
+    pods = [fixtures.pod(requests={"cpu": "10000"})]
+    results = build(pods).solve(pods)
+    assert not results.all_pods_scheduled()
+    reason = next(iter(results.pod_errors.values()))
+    assert "no instance type" in reason
+
+
+def test_node_selector_constrains_node():
+    pods = [
+        fixtures.pod(
+            requests={"cpu": "1"},
+            node_selector={wk.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-2"},
+        )
+    ]
+    results = build(pods).solve(pods)
+    assert results.all_pods_scheduled()
+    claim = results.new_node_claims[0]
+    assert claim.requirements.get(wk.TOPOLOGY_ZONE_LABEL_KEY).values == {"test-zone-2"}
+
+
+def test_unknown_zone_fails():
+    pods = [
+        fixtures.pod(
+            requests={"cpu": "1"},
+            node_selector={wk.TOPOLOGY_ZONE_LABEL_KEY: "mars"},
+        )
+    ]
+    results = build(pods).solve(pods)
+    assert not results.all_pods_scheduled()
+
+
+def test_custom_label_must_be_defined_on_nodepool():
+    pods = [fixtures.pod(requests={"cpu": "1"}, node_selector={"team": "ml"})]
+    # default nodepool doesn't define "team" -> unschedulable
+    assert not build(pods).solve(pods).all_pods_scheduled()
+    # nodepool with the label -> schedules and carries the label requirement
+    np = fixtures.node_pool(labels={"team": "ml"})
+    pods2 = [fixtures.pod(requests={"cpu": "1"}, node_selector={"team": "ml"})]
+    results = build(pods2, node_pools=[np]).solve(pods2)
+    assert results.all_pods_scheduled()
+    assert results.new_node_claims[0].requirements.get("team").values == {"ml"}
+
+
+def test_tainted_nodepool_requires_toleration():
+    np = fixtures.node_pool(taints=[Taint("gpu", TaintEffect.NO_SCHEDULE, "true")])
+    pods = [fixtures.pod(requests={"cpu": "1"})]
+    assert not build(pods, node_pools=[np]).solve(pods).all_pods_scheduled()
+    tolerating = [
+        fixtures.pod(
+            requests={"cpu": "1"},
+            tolerations=[Toleration(key="gpu", operator="Exists")],
+        )
+    ]
+    assert build(tolerating, node_pools=[np]).solve(tolerating).all_pods_scheduled()
+
+
+def test_zonal_topology_spread():
+    fixtures.reset_rng()
+    sel = {"app": "spread"}
+    pods = [
+        fixtures.pod(
+            name=f"s-{i}",
+            labels=dict(sel),
+            requests={"cpu": "1"},
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=wk.TOPOLOGY_ZONE_LABEL_KEY,
+                    label_selector=LabelSelector(match_labels=dict(sel)),
+                )
+            ],
+        )
+        for i in range(9)
+    ]
+    results = build(pods).solve(pods)
+    assert results.all_pods_scheduled()
+    # count pods per zone across claims
+    zone_counts = {}
+    for claim in results.new_node_claims:
+        zones = claim.requirements.get(wk.TOPOLOGY_ZONE_LABEL_KEY).values
+        assert len(zones) == 1  # spread forces a concrete zone per node
+        zone_counts[next(iter(zones))] = zone_counts.get(next(iter(zones)), 0) + len(
+            claim.pods
+        )
+    assert sum(zone_counts.values()) == 9
+    assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+    assert len(zone_counts) == 3
+
+
+def test_hostname_anti_affinity_one_pod_per_node():
+    labels = {"app": "nginx"}
+    pods = [
+        fixtures.pod(
+            name=f"a-{i}",
+            labels=dict(labels),
+            requests={"cpu": "100m"},
+            pod_anti_requirements=[
+                __import__(
+                    "karpenter_tpu.api.objects", fromlist=["PodAffinityTerm"]
+                ).PodAffinityTerm(
+                    topology_key=wk.HOSTNAME_LABEL_KEY,
+                    label_selector=LabelSelector(match_labels=dict(labels)),
+                )
+            ],
+        )
+        for i in range(5)
+    ]
+    results = build(pods).solve(pods)
+    assert results.all_pods_scheduled()
+    assert len(results.new_node_claims) == 5
+    assert all(len(c.pods) == 1 for c in results.new_node_claims)
+
+
+def test_zonal_self_affinity_lands_in_one_zone():
+    from karpenter_tpu.api.objects import PodAffinityTerm
+
+    labels = {"group": "g1"}
+    pods = [
+        fixtures.pod(
+            name=f"aff-{i}",
+            labels=dict(labels),
+            requests={"cpu": "100m"},
+            pod_requirements=[
+                PodAffinityTerm(
+                    topology_key=wk.TOPOLOGY_ZONE_LABEL_KEY,
+                    label_selector=LabelSelector(match_labels=dict(labels)),
+                )
+            ],
+        )
+        for i in range(6)
+    ]
+    results = build(pods).solve(pods)
+    assert results.all_pods_scheduled()
+    zones = set()
+    for claim in results.new_node_claims:
+        zones |= claim.requirements.get(wk.TOPOLOGY_ZONE_LABEL_KEY).values
+    assert len(zones) == 1
+
+
+def test_preference_relaxation():
+    # an unsatisfiable required preference... preferred node affinity to a
+    # nonexistent zone must be relaxed away
+    pods = [
+        fixtures.pod(
+            requests={"cpu": "1"},
+            node_preferences=[
+                NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL_KEY, Operator.IN, ["mars"])
+            ],
+        )
+    ]
+    results = build(pods).solve(pods)
+    assert results.all_pods_scheduled()
+
+
+def test_ignore_preferences_policy():
+    pods = [
+        fixtures.pod(
+            requests={"cpu": "1"},
+            node_preferences=[
+                NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL_KEY, Operator.IN, ["mars"])
+            ],
+        )
+    ]
+    results = build(pods, options=SchedulerOptions(ignore_preferences=True)).solve(pods)
+    assert results.all_pods_scheduled()
+    # preference never constrained the node
+    claim = results.new_node_claims[0]
+    assert "mars" not in claim.requirements.get(wk.TOPOLOGY_ZONE_LABEL_KEY).values
+
+
+def test_nodepool_limits_cap_capacity():
+    np = fixtures.node_pool(limits={"cpu": "4"})
+    # fake-it-3 is 4cpu; anything larger is filtered by limits
+    pods = [fixtures.pod(requests={"cpu": "3"}) for _ in range(3)]
+    results = build(pods, node_pools=[np]).solve(pods)
+    # first node consumes up to 4 cpu pessimistically -> only 1 node fits limits
+    assert len(results.new_node_claims) == 1
+    assert len(results.pod_errors) == 2
+    assert "exceed limits" in next(iter(results.pod_errors.values()))
+
+
+def test_nodepool_weight_order():
+    heavy = fixtures.node_pool(name="heavy", weight=10, labels={"pool": "heavy"})
+    light = fixtures.node_pool(name="light", weight=1, labels={"pool": "light"})
+    pods = [fixtures.pod(requests={"cpu": "1"})]
+    results = build(pods, node_pools=[light, heavy]).solve(pods)
+    assert results.all_pods_scheduled()
+    assert results.new_node_claims[0].nodepool_name == "heavy"
+
+
+def test_existing_node_preferred_over_new():
+    view = StateNodeView(
+        name="existing-1",
+        node_labels={wk.HOSTNAME_LABEL_KEY: "existing-1"},
+        labels={
+            wk.HOSTNAME_LABEL_KEY: "existing-1",
+            wk.NODEPOOL_LABEL_KEY: "default",
+            wk.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-1",
+        },
+        available=res.parse_list({"cpu": "4", "memory": "8Gi", "pods": 10}),
+        capacity=res.parse_list({"cpu": "4", "memory": "8Gi", "pods": 10}),
+        initialized=True,
+    )
+    pods = [fixtures.pod(requests={"cpu": "1"})]
+    results = build(pods, state_nodes=[view]).solve(pods)
+    assert results.all_pods_scheduled()
+    assert len(results.new_node_claims) == 0
+    assert len(results.existing_nodes[0].pods) == 1
+
+
+def test_existing_node_overflow_to_new():
+    view = StateNodeView(
+        name="existing-1",
+        node_labels={wk.HOSTNAME_LABEL_KEY: "existing-1"},
+        labels={
+            wk.HOSTNAME_LABEL_KEY: "existing-1",
+            wk.NODEPOOL_LABEL_KEY: "default",
+        },
+        available=res.parse_list({"cpu": "2", "pods": 10}),
+        capacity=res.parse_list({"cpu": "2", "pods": 10}),
+        initialized=True,
+    )
+    pods = [fixtures.pod(name=f"p{i}", requests={"cpu": "1"}) for i in range(4)]
+    results = build(pods, state_nodes=[view]).solve(pods)
+    assert results.all_pods_scheduled()
+    assert len(results.existing_nodes[0].pods) == 2
+    assert sum(len(c.pods) for c in results.new_node_claims) == 2
+
+
+def test_min_values_instance_type_flexibility():
+    pods = [
+        fixtures.pod(requests={"cpu": "1"}),
+    ]
+    np = fixtures.node_pool(
+        requirements=[
+            NodeSelectorRequirement(
+                wk.INSTANCE_TYPE_LABEL_KEY,
+                Operator.EXISTS,
+                min_values=5,
+            )
+        ]
+    )
+    results = build(pods, node_pools=[np]).solve(pods)
+    assert results.all_pods_scheduled()
+    claim = results.new_node_claims[0]
+    assert len(claim.instance_type_options) >= 5
+
+
+def test_diverse_pods_all_schedule():
+    fixtures.reset_rng()
+    pods = fixtures.make_diverse_pods(100)
+    results = build(pods, instance_types=fake.instance_types(50)).solve(pods)
+    assert results.all_pods_scheduled(), list(results.pod_errors.values())[:3]
+    total = sum(len(c.pods) for c in results.new_node_claims) + sum(
+        len(n.pods) for n in results.existing_nodes
+    )
+    assert total == 100
+
+
+def test_preference_pods_all_schedule():
+    fixtures.reset_rng()
+    pods = fixtures.make_preference_pods(50)
+    results = build(pods, instance_types=fake.instance_types(50)).solve(pods)
+    assert results.all_pods_scheduled()
