@@ -1,0 +1,21 @@
+"""Tensor encodings and jittable kernels for the TPU solver.
+
+The scheduling problem is re-expressed as dense tensors (SURVEY.md §7):
+- `vocab`   — label-value interning + exact int32 resource scaling
+- `encode`  — Requirements / InstanceTypes / Offerings -> bitmask tensors
+- `kernels` — pure jax functions implementing the constraint algebra
+  (intersection-nonempty, Compatible, intersect-update, instance-type
+  filtering) batched over arbitrary leading dimensions
+"""
+
+from karpenter_tpu.ops.vocab import ResourceTable, UnsupportedProblem, Vocab
+from karpenter_tpu.ops.encode import Reqs, encode_requirements, decode_row
+
+__all__ = [
+    "ResourceTable",
+    "UnsupportedProblem",
+    "Vocab",
+    "Reqs",
+    "encode_requirements",
+    "decode_row",
+]

@@ -1,0 +1,156 @@
+"""Pure jittable kernels over the Reqs bitmask encoding.
+
+These reproduce the reference's Requirement algebra exactly (see
+karpenter_tpu/ops/encode.py for the encoding argument):
+
+- ``intersect_nonempty``   == Requirement.HasIntersection per key
+  (requirement.go:197), batched over broadcastable leading dims.
+- ``compat``               == Requirements.Compatible (requirements.go:175):
+  the defined-key rule plus Intersects with the NotIn/DoesNotExist
+  tolerance (requirements.go:248).
+- ``intersect``            == Requirements.Add auto-intersection
+  (requirements.go:127 / requirement.go:158).
+- ``distinct_value_counts`` powers SatisfiesMinValues (types.go:284).
+
+Per-key reductions are matmuls against a one-hot [TW, K] matrix so XLA tiles
+them onto the MXU; everything else is word-wise integer ops on the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_tpu.ops.encode import Reqs
+from karpenter_tpu.ops.vocab import Vocab
+
+
+class VocabArrays(NamedTuple):
+    """Device-resident static vocab tensors."""
+
+    onehot: jax.Array  # [TW, K] f32
+    word2key: jax.Array  # [TW] i32
+    well_known: jax.Array  # [K] bool
+    full_mask: jax.Array  # [TW] u32
+
+    @classmethod
+    def from_vocab(cls, vocab: Vocab) -> "VocabArrays":
+        return cls(
+            onehot=jnp.asarray(vocab.onehot),
+            word2key=jnp.asarray(vocab.word2key),
+            well_known=jnp.asarray(vocab.well_known_mask),
+            full_mask=jnp.asarray(vocab.full_mask),
+        )
+
+
+def seg_any(word_flags: jax.Array, va: VocabArrays) -> jax.Array:
+    """[..., TW] bool -> [..., K] bool: any set word per key."""
+    return (word_flags.astype(jnp.float32) @ va.onehot) > 0
+
+
+def seg_popcount(mask: jax.Array, va: VocabArrays) -> jax.Array:
+    """[..., TW] u32 -> [..., K] i32: set-bit count per key."""
+    pops = jax.lax.population_count(mask).astype(jnp.float32)
+    return (pops @ va.onehot).astype(jnp.int32)
+
+
+def _dne(r: Reqs, va: VocabArrays) -> jax.Array:
+    """[..., K] operator()==DoesNotExist: concrete with empty allowed set."""
+    return ~r.other & ~seg_any(r.mask != 0, va)
+
+
+def intersect_nonempty(a: Reqs, b: Reqs, va: VocabArrays) -> jax.Array:
+    """[..., K] bool — the per-key HasIntersection. Leading dims of a and b
+    must broadcast (e.g. nodes [N, 1, ...] vs one pod [...])."""
+    seg = seg_any((a.mask & b.mask) != 0, va)
+    gt = jnp.maximum(a.gt, b.gt)
+    lt = jnp.minimum(a.lt, b.lt)
+    other = a.other & b.other & (gt < lt)
+    return seg | other
+
+
+def _conflict(a: Reqs, b: Reqs, va: VocabArrays) -> tuple[jax.Array, jax.Array]:
+    """Per-key conflict of shared defined keys, minus the NotIn/DoesNotExist
+    tolerance (requirements.go:248). Returns (conflict[..., K], b_tol)."""
+    nonempty = intersect_nonempty(a, b, va)
+    a_tol = a.notin | _dne(a, va)
+    b_tol = b.notin | _dne(b, va)
+    conflict = a.defined & b.defined & ~nonempty & ~(a_tol & b_tol)
+    return conflict, b_tol
+
+
+def compat(
+    a: Reqs, b: Reqs, va: VocabArrays, allow_undefined_well_known: bool
+) -> jax.Array:
+    """[...] bool — Requirements.Compatible(a=target/node, b=incoming/pod).
+
+    allow_undefined_well_known mirrors passing AllowUndefinedWellKnownLabels
+    (NodeClaim.CanAdd does; ExistingNode.CanAdd does not).
+    """
+    conflict, b_tol = _conflict(a, b, va)
+    def_fail = b.defined & ~a.defined & ~b_tol
+    if allow_undefined_well_known:
+        def_fail = def_fail & ~va.well_known
+    return ~jnp.any(conflict | def_fail, axis=-1)
+
+
+def intersects_only(a: Reqs, b: Reqs, va: VocabArrays) -> jax.Array:
+    """[...] bool — Requirements.Intersects without the defined-key rule
+    (used by InstanceType requirement filtering, nodeclaim.go:376)."""
+    conflict, _ = _conflict(a, b, va)
+    return ~jnp.any(conflict, axis=-1)
+
+
+def intersect(a: Reqs, b: Reqs, va: VocabArrays) -> Reqs:
+    """Key-wise intersection of two requirement sets (Requirements.Add).
+
+    The excluded set of a complement∧complement result is the union of the
+    sides' excluded values refiltered against the *combined* bounds
+    (requirement.go:158); `x.mask | x.exmask` is exactly "within x's own
+    bounds" for every vocab value, so the refilter is two ANDs. A NotIn whose
+    excluded values all fail the combined bounds thereby collapses to Exists
+    (notin=False), which the tolerance rule in compat() relies on.
+    """
+    gt = jnp.maximum(a.gt, b.gt)
+    lt = jnp.minimum(a.lt, b.lt)
+    collapse = gt >= lt
+    other = a.other & b.other & ~collapse
+    keep = ~collapse[..., va.word2key]
+    mask = jnp.where(keep, a.mask & b.mask, jnp.uint32(0))
+    exmask = (a.exmask & (b.mask | b.exmask)) | (b.exmask & (a.mask | a.exmask))
+    exmask = jnp.where(keep & other[..., va.word2key], exmask, jnp.uint32(0))
+    return Reqs(
+        mask=mask,
+        exmask=exmask,
+        other=other,
+        notin=other & seg_any(exmask != 0, va),
+        defined=a.defined | b.defined,
+        gt=gt,
+        lt=lt,
+        minv=jnp.maximum(a.minv, b.minv),
+    )
+
+
+def distinct_value_counts(
+    masks: jax.Array, alive: jax.Array, va: VocabArrays
+) -> jax.Array:
+    """[K] i32 — distinct allowed values per key across alive rows.
+
+    masks: [I, TW] u32 (concrete requirement masks of instance types),
+    alive: [I] bool. The union of per-type value sets, popcounted per key —
+    the quantity SatisfiesMinValues compares against MinValues.
+    """
+    masked = jnp.where(alive[:, None], masks, jnp.uint32(0))
+    union = jax.lax.reduce(masked, jnp.uint32(0), jax.lax.bitwise_or, (0,))
+    return seg_popcount(union, va)
+
+
+def key_bit(mask: jax.Array, word: jax.Array, bit: jax.Array) -> jax.Array:
+    """Gather single value-bits from [..., TW] masks: mask[..., word] >> bit & 1.
+
+    word/bit may be vectors (e.g. per-offering positions); returns bool with
+    the broadcast shape."""
+    return (jnp.take(mask, word, axis=-1) >> bit.astype(jnp.uint32)) & jnp.uint32(1) > 0

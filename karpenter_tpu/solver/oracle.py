@@ -303,7 +303,9 @@ class Scheduler:
         if pod.node_affinity is not None and pod.node_affinity.preferred:
             strict = Requirements.strict_from_pod(pod)
         self.cached_pod_data[pod.uid] = PodData(
-            requests=pod.requests,
+            # RequestsForPods semantics: every pod also consumes one unit of
+            # the `pods` count resource (resources.go:30-38, scheduler.go:481)
+            requests=res.requests_for_pods([pod]),
             requirements=requirements,
             strict_requirements=strict,
         )

@@ -338,12 +338,16 @@ class TopologyGroup:
                 return Requirement(self.key, Operator.IN, [hostname])
             return Requirement(self.key, Operator.DOES_NOT_EXIST)
 
+        # Deterministic tie-break: the reference iterates Go maps (randomized
+        # per iteration), so ties are unspecified there. We determinize to
+        # sorted order; the TPU kernel assigns vocab ids in sorted order so the
+        # two agree bit-for-bit.
         best_domain = None
         best_count = MAX_I32
         if node_domains.operator() == Operator.IN:
-            candidates = (d for d in node_domains.values if d in self.domains)
+            candidates = (d for d in sorted(node_domains.values) if d in self.domains)
         else:
-            candidates = (d for d in self.domains if node_domains.has(d))
+            candidates = (d for d in sorted(self.domains) if node_domains.has(d))
         for domain in candidates:
             count = self.domains[domain]
             if self_selecting:
@@ -415,12 +419,12 @@ class TopologyGroup:
             or not self._any_compatible_pod_domain(pod_domains)
         ):
             intersected = pod_domains.intersection(node_domains)
-            for domain in self.domains:
+            for domain in sorted(self.domains):  # determinized (see spread)
                 if intersected.has(domain):
                     options.values.add(domain)
                     break
             if not options.values:
-                for domain in self.domains:
+                for domain in sorted(self.domains):
                     if pod_domains.has(domain):
                         options.values.add(domain)
                         break

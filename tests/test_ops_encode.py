@@ -1,0 +1,234 @@
+"""Property tests: the bitmask tensor encoding vs the Python Requirement
+algebra (which is itself tested against the reference semantics in
+test_requirements.py). Random requirement pairs must agree on
+HasIntersection, Compatible, and the full intersection's allowed-value set.
+
+All trials share one vocab (the fixed VALUE_POOL) and are batched into a
+single kernel invocation per test, so the jax dispatch overhead is paid once.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.objects import Operator
+from karpenter_tpu.ops import encode_requirements, decode_row, ResourceTable, Vocab
+from karpenter_tpu.ops.encode import Reqs
+from karpenter_tpu.ops.kernels import (
+    VocabArrays,
+    compat,
+    distinct_value_counts,
+    intersect,
+    intersect_nonempty,
+    intersects_only,
+)
+from karpenter_tpu.scheduling import ALLOW_UNDEFINED_WELL_KNOWN_LABELS, Requirement, Requirements
+
+KEYS = [
+    "topology.kubernetes.io/zone",  # well-known
+    "kubernetes.io/arch",  # well-known
+    "example.com/custom-a",
+    "example.com/custom-b",
+    "example.com/int-key",
+]
+VALUE_POOL = {
+    "topology.kubernetes.io/zone": [f"zone-{i}" for i in range(5)],
+    "kubernetes.io/arch": ["amd64", "arm64"],
+    "example.com/custom-a": list("abcdefg"),
+    "example.com/custom-b": list("xyz"),
+    "example.com/int-key": [str(n) for n in (1, 3, 5, 8, 13, 21, 40)],
+}
+
+
+def shared_vocab() -> tuple[Vocab, VocabArrays]:
+    vocab = Vocab()
+    for key, pool in VALUE_POOL.items():
+        for v in pool:
+            vocab.observe_labels({key: v})
+    vocab.finalize()
+    return vocab, VocabArrays.from_vocab(vocab)
+
+
+VOCAB, VA = shared_vocab()
+
+
+def random_requirement(rng: random.Random, key: str) -> Requirement:
+    pool = VALUE_POOL[key]
+    op = rng.choice(
+        [Operator.IN, Operator.NOT_IN, Operator.EXISTS, Operator.DOES_NOT_EXIST]
+        + ([Operator.GT, Operator.LT] if key == "example.com/int-key" else [])
+    )
+    if op in (Operator.IN, Operator.NOT_IN):
+        values = rng.sample(pool, rng.randint(1, min(4, len(pool))))
+    elif op in (Operator.GT, Operator.LT):
+        values = [str(rng.randint(0, 45))]
+    else:
+        values = []
+    return Requirement(key, op, values)
+
+
+def random_requirements(rng: random.Random, max_keys: int = 4) -> Requirements:
+    keys = rng.sample(KEYS, rng.randint(0, max_keys))
+    return Requirements(random_requirement(rng, k) for k in keys)
+
+
+def np_rows(e: Reqs) -> Reqs:
+    return Reqs(*(np.asarray(a) for a in e))
+
+
+def test_has_intersection_pairs():
+    rng = random.Random(7)
+    pairs = []
+    for _ in range(400):
+        key = rng.choice(KEYS)
+        pairs.append((key, random_requirement(rng, key), random_requirement(rng, key)))
+    left = encode_requirements(VOCAB, [Requirements([a.copy()]) for _, a, _ in pairs])
+    right = encode_requirements(VOCAB, [Requirements([b.copy()]) for _, _, b in pairs])
+    got = np.asarray(intersect_nonempty(left, right, VA))
+    for i, (key, a, b) in enumerate(pairs):
+        kid = VOCAB.key_index[key]
+        want = a.has_intersection(b)
+        assert bool(got[i, kid]) == want, f"trial {i}: {a!r} vs {b!r}"
+
+
+def test_compatible_and_intersects_sets():
+    rng = random.Random(11)
+    pairs = [(random_requirements(rng), random_requirements(rng)) for _ in range(400)]
+    left = encode_requirements(VOCAB, [a for a, _ in pairs])
+    right = encode_requirements(VOCAB, [b for _, b in pairs])
+    got_strict = np.asarray(compat(left, right, VA, False))
+    got_allow = np.asarray(compat(left, right, VA, True))
+    got_inter = np.asarray(intersects_only(left, right, VA))
+    for i, (a, b) in enumerate(pairs):
+        assert bool(got_strict[i]) == (a.compatible(b) is None), f"{i}: {a!r} || {b!r}"
+        assert bool(got_allow[i]) == (
+            a.compatible(b, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is None
+        ), f"{i} allow: {a!r} || {b!r}"
+        assert bool(got_inter[i]) == (a.intersects(b) is None), f"{i} ∩: {a!r} {b!r}"
+
+
+def test_intersection_allowed_values_roundtrip():
+    rng = random.Random(17)
+    pairs = []
+    for _ in range(400):
+        key = rng.choice(KEYS)
+        pairs.append((key, random_requirement(rng, key), random_requirement(rng, key)))
+    left = encode_requirements(VOCAB, [Requirements([a.copy()]) for _, a, _ in pairs])
+    right = encode_requirements(VOCAB, [Requirements([b.copy()]) for _, _, b in pairs])
+    merged = np_rows(intersect(left, right, VA))
+    for i, (key, r1, r2) in enumerate(pairs):
+        decoded = decode_row(VOCAB, merged.row(i))
+        want_req = r1.intersection(r2)
+        got_req = decoded.get(key)
+        for v in VALUE_POOL[key] + ["unseen-value", "7", "100"]:
+            if v not in VALUE_POOL[key] and not want_req.complement:
+                # concrete results are exact only over the vocab; concrete
+                # requirement values are always vocab members by construction
+                continue
+            assert got_req.has(v) == want_req.has(v), (
+                f"trial {i}: ({r1!r}) ∩ ({r2!r}) disagree on {v!r}: "
+                f"decoded {got_req!r} want {want_req!r}"
+            )
+        assert got_req.operator() == want_req.operator(), (
+            f"trial {i}: ({r1!r}) ∩ ({r2!r}) operator drift: "
+            f"{got_req.operator()} want {want_req.operator()}"
+        )
+
+
+def test_intersect_notin_collapses_under_bounds():
+    """Regression: NotIn{"1"} ∩ Gt(5) must collapse to Exists (the excluded
+    value fails the combined bounds), so a subsequent DoesNotExist is NOT
+    tolerated — mirroring Requirements.compatible exactly."""
+    key = "example.com/int-key"
+    a = Requirements([Requirement(key, Operator.NOT_IN, ["1"])])
+    b = Requirements([Requirement(key, Operator.GT, ["5"])])
+    c = Requirements([Requirement(key, Operator.DOES_NOT_EXIST)])
+    enc = encode_requirements(VOCAB, [a, b, c])
+    merged = intersect(enc.row(0), enc.row(1), VA)
+    decoded = decode_row(VOCAB, np_rows(merged))
+    want = a.get(key).intersection(b.get(key))
+    assert decoded.get(key).operator() == want.operator() == Operator.EXISTS
+    py = Requirements([want])
+    want_ok = py.compatible(c) is None
+    got_ok = bool(np.asarray(compat(merged, enc.row(2), VA, False)))
+    assert got_ok == want_ok == False  # noqa: E712
+
+
+def test_chained_intersect_then_compat():
+    """Property: compat() on an intersect() result must equal the Python
+    chain Requirements.add + compatible (catches operator-drift bugs)."""
+    rng = random.Random(29)
+    triples = []
+    for _ in range(300):
+        key = rng.choice(KEYS)
+        triples.append((key, *(random_requirement(rng, key) for _ in range(3))))
+    e1 = encode_requirements(VOCAB, [Requirements([a.copy()]) for _, a, _, _ in triples])
+    e2 = encode_requirements(VOCAB, [Requirements([b.copy()]) for _, _, b, _ in triples])
+    e3 = encode_requirements(VOCAB, [Requirements([c.copy()]) for _, _, _, c in triples])
+    merged = intersect(e1, e2, VA)
+    got_strict = np.asarray(compat(merged, e3, VA, False))
+    got_allow = np.asarray(compat(merged, e3, VA, True))
+    for i, (key, r1, r2, r3) in enumerate(triples):
+        py = Requirements([r1.copy()])
+        py.add(r2.copy())
+        s3 = Requirements([r3.copy()])
+        assert bool(got_strict[i]) == (py.compatible(s3) is None), (
+            f"trial {i}: ({r1!r} ∩ {r2!r}) || {r3!r}"
+        )
+        assert bool(got_allow[i]) == (
+            py.compatible(s3, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is None
+        ), f"trial {i} allow: ({r1!r} ∩ {r2!r}) || {r3!r}"
+
+
+def test_decode_roundtrip():
+    rng = random.Random(23)
+    sets = [random_requirements(rng, max_keys=5) for _ in range(150)]
+    enc = np_rows(encode_requirements(VOCAB, sets))
+    for i, s in enumerate(sets):
+        decoded = decode_row(VOCAB, enc.row(i))
+        for key in s:
+            for v in VALUE_POOL[key] + ["unseen", "12"]:
+                assert decoded.get(key).has(v) == s.get(key).has(v), (key, v, s.get(key))
+
+
+def test_distinct_value_counts():
+    sets = [
+        Requirements([Requirement("example.com/custom-a", Operator.IN, ["a", "b"])]),
+        Requirements([Requirement("example.com/custom-a", Operator.IN, ["b", "c"])]),
+        Requirements([Requirement("example.com/custom-a", Operator.IN, ["d"])]),
+    ]
+    enc = encode_requirements(VOCAB, sets)
+    kid = VOCAB.key_index["example.com/custom-a"]
+    alive = np.array([True, True, False])
+    counts = np.asarray(distinct_value_counts(np.asarray(enc.mask), alive, VA))
+    assert counts[kid] == 3  # {a, b, c}
+    alive_all = np.array([True, True, True])
+    counts = np.asarray(distinct_value_counts(np.asarray(enc.mask), alive_all, VA))
+    assert counts[kid] == 4
+
+
+def test_resource_table_exact():
+    table = ResourceTable()
+    mi = 1024 * 1024 * 1000  # 1Mi in milli-bytes
+    table.observe({"cpu": 100, "memory": 100 * mi})
+    table.observe({"cpu": 250, "memory": 2048 * mi})
+    table.observe({"cpu": 128_000, "memory": 262_144 * mi})  # a big node
+    table.finalize()
+    row = table.encode({"cpu": 250, "memory": 2048 * mi})
+    assert table.decode(row) == {"cpu": 250, "memory": 2048 * mi}
+    # scales divide all observed values
+    ci = table.index["cpu"]
+    assert 250 % table.scale[ci] == 0
+
+
+def test_resource_table_rejects_unobserved():
+    from karpenter_tpu.ops import UnsupportedProblem
+
+    table = ResourceTable()
+    table.observe({"cpu": 100})
+    table.finalize()
+    with pytest.raises(UnsupportedProblem):
+        table.encode({"nvidia.com/gpu": 1000})
