@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Benchmark: Scheduler.Solve pods/sec — TPU batched solver vs the in-process
+sequential FFD oracle (BASELINE.md).
+
+Shape mirrors the reference benchmark harness
+(/root/reference/pkg/controllers/provisioning/scheduling/
+scheduling_benchmark_test.go): the diverse pod mix (generic / zonal TSC /
+hostname TSC / zonal self-affinity / hostname anti-affinity) against a
+KWOK-generated instance-type universe.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <tpu pods/sec>, "unit": "pods/sec",
+   "vs_baseline": <tpu / oracle speedup>}
+
+The oracle baseline is measured at min(pods, baseline-cap) pods — Python FFD
+throughput degrades with scale, so capping *understates* the speedup
+(conservative).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_universe(n_types: int):
+    from karpenter_tpu.cloudprovider.kwok import KWOK_FAMILIES, construct_instance_types
+
+    # 1 size => len(families) * 2 os * 2 arch = 12 types
+    per_size = len(KWOK_FAMILIES) * 2 * 2
+    n_sizes = max(1, (n_types + per_size - 1) // per_size)
+    sizes = sorted({1, 2, 4, 8, 16, 32, 48, 64, 96, 128, 192, 256} | set(
+        range(3, 3 + n_sizes * 3, 3)
+    ))[:n_sizes]
+    its = construct_instance_types(sizes=sizes)
+    return its[:n_types] if len(its) > n_types else its
+
+
+def make_problem(n_pods: int, its):
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.testing import fixtures
+
+    fixtures.reset_rng(42)
+    node_pool = fixtures.node_pool(name="default")
+    pods = fixtures.make_diverse_pods(n_pods)
+    topo = Topology([node_pool], {"default": its}, pods)
+    return node_pool, pods, topo
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=10_000)
+    ap.add_argument("--types", type=int, default=500)
+    ap.add_argument("--baseline-cap", type=int, default=2_000)
+    ap.add_argument("--quick", action="store_true", help="tiny shapes (CI smoke)")
+    args = ap.parse_args()
+    if args.quick:
+        args.pods, args.types, args.baseline_cap = 200, 144, 200
+
+    from karpenter_tpu.solver.oracle import Scheduler
+    from karpenter_tpu.solver.tpu import TpuScheduler
+
+    its = build_universe(args.types)
+    log(f"universe: {len(its)} instance types")
+
+    # --- TPU: compile pass, then steady-state measurement ---------------
+    node_pool, pods, topo = make_problem(args.pods, its)
+    t0 = time.monotonic()
+    tpu = TpuScheduler([node_pool], {"default": its}, topo)
+    r = tpu.solve(pods)
+    t_compile = time.monotonic() - t0
+    log(
+        f"tpu warmup: {len(r.new_node_claims)} claims, "
+        f"{len(r.pod_errors)} errors, {t_compile:.1f}s (incl. compile)"
+    )
+
+    node_pool, pods, topo = make_problem(args.pods, its)
+    t0 = time.monotonic()
+    tpu = TpuScheduler([node_pool], {"default": its}, topo)
+    r = tpu.solve(pods)
+    t_tpu = time.monotonic() - t0
+    tpu_ps = args.pods / t_tpu
+    log(f"tpu solve: {t_tpu:.2f}s -> {tpu_ps:.0f} pods/sec")
+
+    # --- oracle baseline -------------------------------------------------
+    n_base = min(args.pods, args.baseline_cap)
+    node_pool, pods_b, topo_b = make_problem(n_base, its)
+    oracle = Scheduler([node_pool], {"default": its}, topo_b)
+    t0 = time.monotonic()
+    rb = oracle.solve(pods_b)
+    t_oracle = time.monotonic() - t0
+    oracle_ps = n_base / t_oracle
+    log(
+        f"oracle baseline ({n_base} pods): {t_oracle:.2f}s -> "
+        f"{oracle_ps:.0f} pods/sec ({len(rb.new_node_claims)} claims)"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"Scheduler.Solve pods/sec at {args.pods} pending x "
+                    f"{len(its)} instance types (KWOK, diverse mix)"
+                ),
+                "value": round(tpu_ps, 1),
+                "unit": "pods/sec",
+                "vs_baseline": round(tpu_ps / oracle_ps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -141,16 +141,11 @@ def distinct_value_counts(
 
     masks: [I, TW] u32 (concrete requirement masks of instance types),
     alive: [I] bool. The union of per-type value sets, popcounted per key —
-    the quantity SatisfiesMinValues compares against MinValues.
+    the quantity SatisfiesMinValues compares against MinValues. Callers must
+    pre-select the per-key source (`.values` semantics: concrete -> mask,
+    complement -> exmask, undefined -> zero), as the solver's
+    _min_values_ok does.
     """
     masked = jnp.where(alive[:, None], masks, jnp.uint32(0))
     union = jax.lax.reduce(masked, jnp.uint32(0), jax.lax.bitwise_or, (0,))
     return seg_popcount(union, va)
-
-
-def key_bit(mask: jax.Array, word: jax.Array, bit: jax.Array) -> jax.Array:
-    """Gather single value-bits from [..., TW] masks: mask[..., word] >> bit & 1.
-
-    word/bit may be vectors (e.g. per-offering positions); returns bool with
-    the broadcast shape."""
-    return (jnp.take(mask, word, axis=-1) >> bit.astype(jnp.uint32)) & jnp.uint32(1) > 0

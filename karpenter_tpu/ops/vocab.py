@@ -115,10 +115,6 @@ class Vocab:
         word = self.word_offset[key_id] + value_id // WORD_BITS
         flat[word] |= np.uint32(1 << (value_id % WORD_BITS))
 
-    def key_values_array(self, key: str) -> list[str]:
-        kid = self.key_index.get(key)
-        return self.values[kid] if kid is not None else []
-
 
 class ResourceTable:
     """Fixed resource-dimension layout with exact per-resource GCD scaling."""

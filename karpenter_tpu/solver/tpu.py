@@ -1,0 +1,388 @@
+"""TpuScheduler: the TPU-native batched solver with oracle fallback.
+
+Drop-in for karpenter_tpu.solver.oracle.Scheduler (same constructor, same
+solve() -> Results), implementing SURVEY.md §7 M3/M4: the whole scheduling
+problem is encoded once into dense tensors (solver/tpu_problem.py) and a
+jitted lax.scan packs pods at device speed (solver/tpu_kernel.py), while
+the host only sorts pods, pads shapes, and decodes results.
+
+Fidelity contract: for supported problems the per-pod decisions (which
+existing node / in-flight claim / new template, in first-fit order) are
+bit-identical to the oracle — tests/test_tpu_parity.py enforces this against
+randomized problem mixes, including the reference benchmark's diverse pod
+classes (scheduling_benchmark_test.go:257 makeDiversePods). Unsupported
+features (preference relaxation, host ports, reserved capacity, hostname
+selectors, exotic topology filters) raise UnsupportedBySolver at encode
+time; Solver.solve() then falls back to the oracle — the hybrid dispatch.
+
+The queue progress loop (scheduler.go:380 "schedule again if progress was
+made") maps to outer rounds: failed pods are re-submitted against the
+carried device state while any round schedules at least one pod — provably
+equivalent to the reference's requeue-at-end + stall detection.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time as time_mod
+from typing import Optional
+
+import numpy as np
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import NodePool, Operator, Pod
+from karpenter_tpu.cloudprovider.types import InstanceTypes
+from karpenter_tpu.ops.encode import Reqs, decode_row
+from karpenter_tpu.ops.kernels import VocabArrays
+from karpenter_tpu.scheduling import Requirement, Requirements
+from karpenter_tpu.solver.nodes import (
+    SchedulingNodeClaim,
+    StateNodeView,
+    filter_instance_types,
+)
+from karpenter_tpu.solver.oracle import Results, Scheduler, SchedulerOptions
+from karpenter_tpu.solver.topology import Topology
+from karpenter_tpu.solver.tpu_problem import (
+    EncodedProblem,
+    UnsupportedBySolver,
+    encode_problem,
+)
+from karpenter_tpu.utils import resources as res
+
+_claim_seq = itertools.count(1)
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+class TpuScheduler:
+    """Same surface as oracle.Scheduler, solving on the accelerator."""
+
+    def __init__(
+        self,
+        node_pools: list[NodePool],
+        instance_types_by_pool: dict[str, InstanceTypes],
+        topology: Topology,
+        state_nodes: Optional[list[StateNodeView]] = None,
+        daemonset_pods: Optional[list[Pod]] = None,
+        options: Optional[SchedulerOptions] = None,
+    ):
+        # reuse the oracle's init wholesale: template filtering, daemon
+        # overhead, existing-node ordering, limits (scheduler.go:116)
+        self.oracle = Scheduler(
+            node_pools,
+            instance_types_by_pool,
+            topology,
+            state_nodes,
+            daemonset_pods,
+            options,
+        )
+        self.opts = self.oracle.opts
+
+    # -- solve ----------------------------------------------------------
+
+    def solve(self, pods: list[Pod]) -> Results:
+        """May raise UnsupportedBySolver; Solver wrappers catch and fall
+        back to the oracle."""
+        import jax  # deferred so encoding errors surface first
+
+        problem = encode_problem(self.oracle, pods)
+        deadline = (
+            time_mod.monotonic() + self.opts.timeout_seconds
+            if self.opts.timeout_seconds
+            else None
+        )
+
+        # FFD order (queue.go:72): cpu desc, memory desc, creation, uid
+        data = self.oracle.cached_pod_data
+        for p in pods:
+            self.oracle._update_cached_pod_data(p)
+        order = sorted(
+            range(len(pods)),
+            key=lambda i: (
+                -data[pods[i].uid].requests.get(res.CPU, 0),
+                -data[pods[i].uid].requests.get(res.MEMORY, 0),
+                pods[i].metadata.creation_timestamp,
+                pods[i].uid,
+            ),
+        )
+
+        from karpenter_tpu.solver import tpu_kernel as K
+
+        tb = self._tables(problem)
+        N = _pow2(len(pods))  # claim slots; pow2 so shape buckets are reused
+        st = self._init_state(problem, N)
+
+        kinds = np.full(len(pods), K.KIND_FAIL, dtype=np.int32)
+        slots = np.full(len(pods), -1, dtype=np.int32)
+        pending = list(order)
+        timed_out = False
+        while pending:
+            if deadline is not None and time_mod.monotonic() > deadline:
+                timed_out = True
+                break
+            xs = self._pod_xs(problem, pending)
+            st, got_kinds, got_slots = K.solve_scan(tb, st, xs)
+            # one batched device->host fetch (the tunnel charges per call)
+            got_kinds, got_slots = jax.device_get((got_kinds, got_slots))
+            got_kinds = got_kinds[: len(pending)]
+            got_slots = got_slots[: len(pending)]
+            kinds[pending] = got_kinds
+            slots[pending] = got_slots
+            failed = [i for i, k in zip(pending, got_kinds) if k == K.KIND_FAIL]
+            if len(failed) == len(pending):
+                break  # no progress: stall (queue.go:52)
+            pending = failed
+
+        return self._decode(problem, st, kinds, slots, timed_out)
+
+    # -- tensor construction --------------------------------------------
+
+    def _tables(self, p: EncodedProblem):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.solver import tpu_kernel as K
+
+        def pad_group_v(a, fill=0):
+            if a.shape[0] == 0:
+                return jnp.asarray(
+                    np.full((1,) + a.shape[1:], fill, dtype=a.dtype)
+                )
+            return jnp.asarray(a)
+
+        Gv, Gh = len(p.vgroups), len(p.hgroups)
+        va = VocabArrays.from_vocab(p.vocab)
+        v_anti = np.array(
+            [g.group.type.value == 2 for g in p.vgroups], dtype=bool
+        ).reshape(Gv)
+        h_inverse = np.array([g.inverse for g in p.hgroups], dtype=bool).reshape(Gh)
+        jreq = lambda r: Reqs(*(jnp.asarray(a) for a in r))
+
+        def pad_reqs_rows(r: Reqs) -> Reqs:
+            if r.mask.shape[0] > 0:
+                return jreq(r)
+            return Reqs(
+                *(
+                    jnp.asarray(np.zeros((0,) + a.shape[1:], dtype=a.dtype))
+                    for a in r
+                )
+            )
+
+        return K.Tables(
+            va=va,
+            treq=jreq(p.treq),
+            tdaemon=jnp.asarray(p.tdaemon),
+            ttypes=jnp.asarray(p.ttypes),
+            tlimit_def=jnp.asarray(p.tlimit_def),
+            thas_limits=jnp.asarray(p.thas_limits),
+            ireq=jreq(p.ireq),
+            ialloc=jnp.asarray(p.ialloc),
+            icap=jnp.asarray(p.icap),
+            otype=jnp.asarray(p.otype),
+            oword=jnp.asarray(p.oword),
+            obit=jnp.asarray(p.obit),
+            v_kid=pad_group_v(p.v_kid),
+            v_word=pad_group_v(p.v_word, fill=-1),
+            v_bit=pad_group_v(p.v_bit),
+            v_reg=pad_group_v(p.v_reg, fill=False),
+            v_skew=pad_group_v(p.v_skew),
+            v_mindom=pad_group_v(p.v_mindom, fill=-1),
+            v_filt=pad_group_v(p.v_filt, fill=-1),
+            v_anti=pad_group_v(v_anti, fill=False),
+            h_skew=pad_group_v(p.h_skew),
+            h_filt=pad_group_v(p.h_filt, fill=-1),
+            h_inverse=pad_group_v(h_inverse, fill=False),
+            filter_reqs=pad_reqs_rows(p.filter_reqs),
+        )
+
+    def _init_state(self, p: EncodedProblem, N: int):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.encode import empty_reqs
+        from karpenter_tpu.solver import tpu_kernel as K
+
+        vocab, table = p.vocab, p.table
+        R = table.num_resources
+        I = p.num_types
+        IW = max(1, (I + 31) // 32)
+        E = p.num_existing
+        Gv = max(len(p.vgroups), 1)
+        Gh = max(len(p.hgroups), 1)
+        S = E + N
+        creq = empty_reqs(vocab, (N,))
+        jreq = lambda r: Reqs(*(jnp.asarray(a) for a in r))
+        v_cnt = (
+            p.v_cnt if len(p.vgroups) else np.zeros((1, p.vmax or 1), np.int32)
+        )
+        h_cnt = np.zeros((Gh, S), np.int32)
+        for g, slot, c in p.h_seed:
+            h_cnt[g, slot] += c
+        return K.State(
+            active=jnp.zeros(N, bool),
+            count=jnp.zeros(N, jnp.int32),
+            rank=jnp.zeros(N, jnp.int32),
+            tmpl=jnp.zeros(N, jnp.int32),
+            creq=jreq(creq),
+            crequests=jnp.zeros((N, R), jnp.int32),
+            alive=jnp.zeros((N, IW), jnp.uint32),
+            cmax_alloc=jnp.zeros((N, R), jnp.int32),
+            n_claims=jnp.zeros((), jnp.int32),
+            ereq=jreq(p.ereq),
+            eavail=jnp.asarray(p.eavail),
+            trem=jnp.asarray(p.tlimit_rem),
+            v_cnt=jnp.asarray(v_cnt),
+            h_cnt=jnp.asarray(h_cnt),
+        )
+
+    def _pod_xs(self, p: EncodedProblem, indices: list[int]):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.solver import tpu_kernel as K
+
+        n = len(indices)
+        P_pad = _pow2(n)
+        idx = np.array(indices + [0] * (P_pad - n), dtype=np.int32)
+        valid = np.zeros(P_pad, bool)
+        valid[:n] = True
+        Gv = max(len(p.vgroups), 1)
+        Gh = max(len(p.hgroups), 1)
+
+        def pad_g(a, G):
+            if a.shape[1] == G:
+                return a[idx]
+            return np.zeros((P_pad, G), a.dtype)
+
+        return K.PodX(
+            preq=Reqs(*(jnp.asarray(a[idx]) for a in p.preq)),
+            prequests=jnp.asarray(p.prequests[idx]),
+            tol_t=jnp.asarray(p.ptol_t[idx]),
+            tol_e=jnp.asarray(p.ptol_e[idx]),
+            topo_kind=jnp.asarray(p.ptopo_kind[idx]),
+            topo_gid=jnp.asarray(p.ptopo_gid[idx]),
+            topo_sel=jnp.asarray(p.ptopo_sel[idx]),
+            sel_v=jnp.asarray(pad_g(p.psel_v, Gv)),
+            sel_h=jnp.asarray(pad_g(p.psel_h, Gh)),
+            inv_h=jnp.asarray(pad_g(p.pinv_h, Gh)),
+            own_h=jnp.asarray(pad_g(p.pown_h, Gh)),
+            valid=jnp.asarray(valid),
+        )
+
+    # -- decoding --------------------------------------------------------
+
+    def _decode(
+        self,
+        p: EncodedProblem,
+        st,
+        kinds: np.ndarray,
+        slots: np.ndarray,
+        timed_out: bool,
+    ) -> Results:
+        import jax
+
+        from karpenter_tpu.solver import tpu_kernel as K
+
+        vocab, table = p.vocab, p.table
+        scheduler = self.oracle
+        # one batched device->host fetch of everything decode reads
+        st = jax.device_get(st)
+        n_claims = int(st.n_claims)
+        creq = Reqs(*(np.asarray(a) for a in st.creq))
+        crequests = np.asarray(st.crequests)
+        alive = np.asarray(st.alive)
+        tmpl = np.asarray(st.tmpl)
+        eavail = np.asarray(st.eavail)
+        ereq = Reqs(*(np.asarray(a) for a in st.ereq))
+
+        # global type table order (same construction as encode_problem)
+        type_idx: dict[int, int] = {}
+        for nct in scheduler.templates:
+            for it in nct.instance_type_options:
+                if id(it) not in type_idx:
+                    type_idx[id(it)] = len(type_idx)
+
+        claims: list[SchedulingNodeClaim] = []
+        for slot in range(n_claims):
+            nct = scheduler.templates[int(tmpl[slot])]
+            claim = SchedulingNodeClaim.__new__(SchedulingNodeClaim)
+            claim.template = nct
+            claim.hostname = f"hostname-placeholder-{next(_claim_seq):04d}"
+            claim.requirements = decode_row(vocab, creq.row(slot))
+            live = [
+                it
+                for it in nct.instance_type_options
+                if (alive[slot][type_idx[id(it)] // 32] >> (type_idx[id(it)] % 32)) & 1
+            ]
+            claim.instance_type_options = InstanceTypes(live)
+            claim.requests = table.decode(crequests[slot])
+            claim.daemon_resources = scheduler.daemon_overhead[nct]
+            claim.pods = []
+            claim.topology = scheduler.topology
+            claim.host_port_usage = scheduler.daemon_host_ports[nct].copy()
+            claim.reservation_manager = scheduler.reservation_manager
+            claim.reserved_offerings = []
+            claim.reserved_offering_strict = False
+            claim.reserved_capacity_enabled = False
+            claim.annotations = dict(nct.annotations)
+            claims.append(claim)
+
+        for e, node in enumerate(scheduler.existing_nodes):
+            node.remaining_resources = table.decode(eavail[e])
+            reqs = decode_row(vocab, ereq.row(e))
+            reqs.add(
+                Requirement(
+                    well_known.HOSTNAME_LABEL_KEY, Operator.IN, [node.view.hostname]
+                )
+            )
+            node.requirements = reqs
+
+        pod_errors: dict[str, str] = {}
+        for i, pod in enumerate(p.pods):
+            kind, slot = int(kinds[i]), int(slots[i])
+            if kind == K.KIND_EXISTING:
+                scheduler.existing_nodes[slot].pods.append(pod)
+            elif kind in (K.KIND_CLAIM, K.KIND_NEW):
+                claims[slot].pods.append(pod)
+            elif not timed_out:
+                pod_errors[pod.uid] = self._error_for(pod)
+
+        scheduler.new_node_claims = claims
+        return Results(
+            new_node_claims=claims,
+            existing_nodes=scheduler.existing_nodes,
+            pod_errors=pod_errors,
+            timed_out=timed_out,
+        )
+
+    def _error_for(self, pod: Pod) -> str:
+        """Reconstruct a template-level failure message host-side
+        (nodeclaim.go:296 semantics). Topology-caused failures get a generic
+        message — the batched solver doesn't track per-template reasons."""
+        scheduler = self.oracle
+        data = scheduler.cached_pod_data[pod.uid]
+        errs = []
+        for nct in scheduler.templates:
+            requirements = Requirements(nct.requirements.values())
+            err = requirements.compatible(data.requirements)
+            if err is not None:
+                errs.append(f"incompatible requirements, {err}")
+                continue
+            requirements.add(*data.requirements.values())
+            total = res.merge(
+                scheduler.daemon_overhead[nct], data.requests
+            )
+            _, _, ferr = filter_instance_types(
+                nct.instance_type_options,
+                requirements,
+                data.requests,
+                scheduler.daemon_overhead[nct],
+                total,
+            )
+            if ferr is not None:
+                errs.append(str(ferr))
+        if not errs:
+            return "unsatisfiable topology constraint"
+        return "; ".join(errs)

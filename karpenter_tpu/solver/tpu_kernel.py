@@ -1,0 +1,696 @@
+"""The jitted greedy-packing kernel: one `lax.scan` step per pod, all
+candidate evaluation vectorized.
+
+Reproduces the oracle's decision sequence exactly (scheduler.go:488 add):
+existing nodes in fixed order, then in-flight claims in stable-sorted
+(pod-count, attainment-order) rank, then a new claim from the first feasible
+template in weight order. Candidate screens are exact for requirements,
+taints, and topology; the instance-type dimension (nodeclaim.go:373
+filterInstanceTypesByRequirements) is screened optimistically with a
+per-claim elementwise-max allocatable bound and verified exactly — in rank
+order — inside a while_loop, so the chosen target always equals the oracle's
+first full-pass target.
+
+Stable-rank bookkeeping: the oracle re-sorts in-flight claims by pod count
+(stable) before every attempt. A claim whose count increments moves to the
+front of its new count-block; a new claim enters at the front of the
+count>=2 block boundary (i.e. end of the count-1 block). Both are O(N)
+rank-vector updates — see _rank_after_increment / _rank_after_create.
+
+Topology state is two count tensors: value-keyed groups count per vocab
+value id ("zone family", [Gv, VMAX]); hostname groups count per node slot
+([Gh, S], slots = existing nodes then claim slots), because a node IS its
+hostname domain. Spread max-skew argmin, affinity viable-set, anti empty-set
+and the inverse anti-affinity index mirror topologygroup.go:226-459 (with
+ties determinized to sorted order on both sides).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu.ops.encode import Reqs
+from karpenter_tpu.ops.kernels import (
+    VocabArrays,
+    compat,
+    intersect,
+    intersects_only,
+    seg_any,
+    seg_popcount,
+)
+from karpenter_tpu.solver.tpu_problem import (
+    MAX_OWNED_TOPOLOGIES,
+    TOPO_AFFINITY_H,
+    TOPO_AFFINITY_V,
+    TOPO_ANTI_H,
+    TOPO_ANTI_V,
+    TOPO_NONE,
+    TOPO_SPREAD_H,
+    TOPO_SPREAD_V,
+)
+
+INF_I = jnp.int32(1 << 30)
+INF_F = jnp.float32(1 << 30)
+
+KIND_EXISTING = 0
+KIND_CLAIM = 1
+KIND_NEW = 2
+KIND_FAIL = 3
+
+
+class Tables(NamedTuple):
+    """Static (per-solve) device tensors."""
+
+    va: VocabArrays
+    # templates [T]
+    treq: Reqs
+    tdaemon: jax.Array  # [T, R]
+    ttypes: jax.Array  # [T, IW] u32
+    tlimit_def: jax.Array  # [T, R] bool
+    thas_limits: jax.Array  # [T] bool
+    # instance types [I]
+    ireq: Reqs
+    ialloc: jax.Array  # [I, R]
+    icap: jax.Array  # [I, R]
+    # offerings [O]
+    otype: jax.Array  # [O]
+    oword: jax.Array  # [O, 3]
+    obit: jax.Array  # [O, 3]
+    # zone-family groups [Gv, VMAX]
+    v_kid: jax.Array
+    v_word: jax.Array
+    v_bit: jax.Array
+    v_reg: jax.Array
+    v_skew: jax.Array
+    v_mindom: jax.Array
+    v_filt: jax.Array  # [Gv, 2]
+    v_anti: jax.Array  # [Gv] bool
+    # hostname-family groups [Gh]
+    h_skew: jax.Array
+    h_filt: jax.Array  # [Gh, 2]
+    h_inverse: jax.Array  # [Gh] bool
+    # node filters [F]
+    filter_reqs: Reqs
+
+
+class State(NamedTuple):
+    """Carried solver state."""
+
+    # claims [N]
+    active: jax.Array
+    count: jax.Array
+    rank: jax.Array
+    tmpl: jax.Array
+    creq: Reqs
+    crequests: jax.Array  # [N, R]
+    alive: jax.Array  # [N, IW] u32
+    cmax_alloc: jax.Array  # [N, R]
+    n_claims: jax.Array  # scalar i32
+    # existing nodes [E]
+    ereq: Reqs
+    eavail: jax.Array  # [E, R]
+    # per-template remaining limits [T, R]
+    trem: jax.Array
+    # topology counts
+    v_cnt: jax.Array  # [Gv, VMAX]
+    h_cnt: jax.Array  # [Gh, S]  S = E + N
+
+
+class PodX(NamedTuple):
+    """Per-pod scan inputs."""
+
+    preq: Reqs
+    prequests: jax.Array  # [R]
+    tol_t: jax.Array  # [T]
+    tol_e: jax.Array  # [E]
+    topo_kind: jax.Array  # [C]
+    topo_gid: jax.Array  # [C]
+    topo_sel: jax.Array  # [C]
+    sel_v: jax.Array  # [Gv]
+    sel_h: jax.Array  # [Gh]
+    inv_h: jax.Array  # [Gh]
+    own_h: jax.Array  # [Gh]
+    valid: jax.Array  # scalar bool
+
+
+def _row(r: Reqs, i) -> Reqs:
+    return Reqs(*(a[i] for a in r))
+
+
+def _reqs_where(c, a: Reqs, b: Reqs) -> Reqs:
+    return Reqs(*(jnp.where(c[..., None], x, y) for x, y in zip(a, b)))
+
+
+def _set_row(dst: Reqs, i, row: Reqs, pred) -> Reqs:
+    return Reqs(
+        *(
+            a.at[i].set(jnp.where(pred, v, a[i]))
+            for a, v in zip(dst, row)
+        )
+    )
+
+
+def _gather_bits(mask: jax.Array, words: jax.Array, bits: jax.Array) -> jax.Array:
+    """mask [..., TW], words/bits [G...]: -1 words gather False."""
+    w = jnp.maximum(words, 0)
+    got = (jnp.take(mask, w, axis=-1) >> bits.astype(jnp.uint32)) & jnp.uint32(1)
+    return (got > 0) & (words >= 0)
+
+
+def _unpack(words: jax.Array, n: int) -> jax.Array:
+    """[IW] u32 -> [n] bool."""
+    i = jnp.arange(n)
+    return (words[i // 32] >> (i % 32).astype(jnp.uint32)) & jnp.uint32(1) > 0
+
+
+def _pack(bits: jax.Array, nw: int) -> jax.Array:
+    """[n] bool -> [nw] u32."""
+    i = jnp.arange(bits.shape[0])
+    vals = bits.astype(jnp.uint32) << (i % 32).astype(jnp.uint32)
+    return jnp.zeros(nw, jnp.uint32).at[i // 32].add(vals)
+
+
+# ---------------------------------------------------------------------------
+# topology evaluation
+
+
+class TopoEval(NamedTuple):
+    viable: jax.Array  # [B]
+    tight: jax.Array  # [B, TW] mask to AND in
+    touched: jax.Array  # [K] keys tightened by zone-family constraints
+
+
+def _eval_topology(
+    merged: Reqs,  # [B, ...]
+    slot_cnt_h: jax.Array,  # [Gh, B] hostname counts at each candidate's slot
+    nonempty_h: jax.Array,  # [Gh] any nonzero count in the group row
+    x: PodX,
+    st: State,
+    tb: Tables,
+) -> TopoEval:
+    B = merged.mask.shape[0]
+    TW = merged.mask.shape[-1]
+    Gv = tb.v_reg.shape[0]
+    viable = jnp.ones(B, bool)
+    tight = jnp.broadcast_to(tb.va.full_mask, (B, TW))
+    touched = jnp.zeros(tb.va.well_known.shape[0], bool)
+
+    # inverse anti-affinity applies to any selected pod (topology.go:528)
+    inv_bad = jnp.any(x.inv_h[:, None] & (slot_cnt_h > 0), axis=0)
+    viable &= ~inv_bad
+
+    for c in range(x.topo_kind.shape[0]):  # sized to the problem's max
+        kind = x.topo_kind[c]
+        gid = x.topo_gid[c]
+        selfsel = x.topo_sel[c].astype(jnp.int32)
+
+        # ---- zone-family quantities (safe even when kind is hostname) ----
+        gv = jnp.clip(gid, 0, max(Gv - 1, 0))
+        words = tb.v_word[gv]
+        bitsp = tb.v_bit[gv]
+        reg = tb.v_reg[gv]
+        cnt = st.v_cnt[gv]  # [VMAX] i32 — keep integer for exact compares
+        skew = tb.v_skew[gv]
+        # allowed-mask bits encode has() for concrete AND complement
+        # requirements alike (complements have non-excluded vocab bits set),
+        # and only vocab (registered) domains matter for counting
+        node_bits = _gather_bits(merged.mask, words, bitsp)  # [B, VMAX]
+        pod_dom = _gather_bits(x.preq.mask, words, bitsp)  # [VMAX]
+        eff = cnt + selfsel
+
+        vmax = words.shape[0]
+
+        # spread (topologygroup.go:226): min over pod-supported registered
+        # domains, candidates from node(merged) ∩ registered; pick the first
+        # (lowest value id == sorted order) domain holding the minimum count
+        # — all in exact int32
+        sup = reg & pod_dom
+        min_cnt = jnp.min(jnp.where(sup, cnt, INF_I))  # raw counts, no self-add
+        n_sup = jnp.sum(sup.astype(jnp.int32))
+        mindom = tb.v_mindom[gv]
+        min_cnt = jnp.where((mindom >= 0) & (n_sup < mindom), 0, min_cnt)
+        cand_s = node_bits & reg  # [B, VMAX]
+        ok_s = cand_s & (eff - min_cnt <= skew)
+        best_eff = jnp.min(jnp.where(ok_s, eff, INF_I), axis=-1, keepdims=True)
+        spread_viable = jnp.any(ok_s, axis=-1)
+        first = jnp.argmax(ok_s & (eff == best_eff), axis=-1)  # [B]
+        spread_bits = (jnp.arange(vmax) == first[:, None]) & spread_viable[:, None]
+
+        # affinity (topologygroup.go:313)
+        pos = reg & (st.v_cnt[gv] > 0)
+        aff_set = node_bits & pos & pod_dom  # [B, VMAX]
+        aff_direct = jnp.any(aff_set, axis=-1)
+        nonempty_total = jnp.any(pos)
+        any_compat = jnp.any(pos & pod_dom)
+        bootstrap = (selfsel > 0) & (~nonempty_total | ~any_compat)
+        b_cand = reg & pod_dom & node_bits
+        b_first = jnp.argmax(b_cand, axis=-1)
+        b_ok = jnp.any(b_cand, axis=-1) & bootstrap
+        b_bits = (jnp.arange(vmax) == b_first[:, None]) & b_ok[:, None]
+        aff_viable = aff_direct | b_ok
+        aff_bits = jnp.where(aff_direct[:, None], aff_set, b_bits)
+
+        # anti (topologygroup.go:393): only empty registered domains
+        anti_bits = reg & (st.v_cnt[gv] == 0) & node_bits & pod_dom
+        anti_viable = jnp.any(anti_bits, axis=-1)
+
+        # ---- hostname-family ----
+        gh_cnt = slot_cnt_h[jnp.clip(gid, 0, slot_cnt_h.shape[0] - 1)]  # [B]
+        h_skew = tb.h_skew[jnp.clip(gid, 0, tb.h_skew.shape[0] - 1)]
+        h_ne = nonempty_h[jnp.clip(gid, 0, nonempty_h.shape[0] - 1)]
+        hs_viable = gh_cnt + selfsel <= h_skew
+        ha_viable = (gh_cnt > 0) | ((selfsel > 0) & ~h_ne)
+        hanti_viable = gh_cnt == 0
+
+        is_v = (kind == TOPO_SPREAD_V) | (kind == TOPO_AFFINITY_V) | (kind == TOPO_ANTI_V)
+        c_viable = jnp.where(
+            kind == TOPO_NONE,
+            True,
+            jnp.where(
+                kind == TOPO_SPREAD_V,
+                spread_viable,
+                jnp.where(
+                    kind == TOPO_AFFINITY_V,
+                    aff_viable,
+                    jnp.where(
+                        kind == TOPO_ANTI_V,
+                        anti_viable,
+                        jnp.where(
+                            kind == TOPO_SPREAD_H,
+                            hs_viable,
+                            jnp.where(kind == TOPO_AFFINITY_H, ha_viable, hanti_viable),
+                        ),
+                    ),
+                ),
+            ),
+        )
+        viable &= c_viable
+
+        c_bits = jnp.where(
+            kind == TOPO_SPREAD_V,
+            spread_bits,
+            jnp.where(kind == TOPO_AFFINITY_V, aff_bits, anti_bits),
+        )  # [B, VMAX]
+        # fold the allowed set into a [B, TW] word mask for the group's key
+        kid = tb.v_kid[gv]
+        in_seg = tb.va.word2key == kid  # [TW]
+        vals = c_bits.astype(jnp.uint32) << bitsp.astype(jnp.uint32)
+        delta = (
+            jnp.zeros((B, TW), jnp.uint32)
+            .at[:, jnp.maximum(words, 0)]
+            .add(jnp.where(words >= 0, vals, 0))
+        )
+        seg_tight = jnp.where(in_seg & is_v, delta, jnp.uint32(0xFFFFFFFF))
+        tight = tight & seg_tight
+        touched = touched | (
+            is_v & (jnp.arange(touched.shape[0]) == kid)
+        )
+
+    return TopoEval(viable=viable, tight=tight, touched=touched)
+
+
+def _apply_tighten(merged: Reqs, te_tight: jax.Array, touched: jax.Array, va: VocabArrays) -> Reqs:
+    """Intersect merged reqs with the topology domain choices (an In set per
+    touched key): concrete result, defined, no bounds change."""
+    touched_w = touched[..., va.word2key]
+    return Reqs(
+        mask=merged.mask & te_tight,
+        exmask=jnp.where(touched_w, jnp.uint32(0), merged.exmask),
+        other=merged.other & ~touched,
+        notin=merged.notin & ~touched,
+        defined=merged.defined | touched,
+        gt=merged.gt,
+        lt=merged.lt,
+        minv=merged.minv,
+    )
+
+
+def _topo_nonempty_ok(final: Reqs, touched: jax.Array, va: VocabArrays) -> jax.Array:
+    """The oracle's post-tighten Compatible check: every touched key must
+    keep a nonempty allowed set (scheduler nodeclaim.go:147)."""
+    seg = seg_any(final.mask != 0, va)
+    return ~jnp.any(touched & ~seg, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# instance-type exact filtering
+
+
+def _type_filter(
+    final: Reqs,  # single row
+    alive_bits: jax.Array,  # [I] bool
+    total: jax.Array,  # [R]
+    tb: Tables,
+) -> jax.Array:
+    """[I] bool — compat ∧ fits ∧ offering ∧ (alive), nodeclaim.go:373."""
+    t_ok = intersects_only(tb.ireq, _broadcast_row(final, tb.ireq.mask.shape[0]), tb.va)
+    fits = jnp.all(total <= tb.ialloc, axis=-1)
+    ow = tb.oword
+    off_bit = _gather_bits(final.mask, ow, tb.obit)  # [O, 3]
+    off_ok = jnp.all(off_bit | (ow < 0), axis=-1)
+    off_any = jnp.zeros(tb.ireq.mask.shape[0], bool).at[tb.otype].max(off_ok)
+    return alive_bits & t_ok & fits & off_any
+
+
+def _broadcast_row(r: Reqs, n: int) -> Reqs:
+    return Reqs(*(jnp.broadcast_to(a, (n,) + a.shape) for a in r))
+
+
+def _min_values_ok(final: Reqs, final_i: jax.Array, tb: Tables) -> jax.Array:
+    # SatisfiesMinValues unions `requirement.values` per key (types.py:188):
+    # concrete rows contribute their allowed set, complements their *excluded*
+    # set, and undefined keys nothing — never the full Exists mask
+    src = jnp.where(
+        tb.ireq.other[..., tb.va.word2key], tb.ireq.exmask, tb.ireq.mask
+    )
+    src = jnp.where(tb.ireq.defined[..., tb.va.word2key], src, jnp.uint32(0))
+    union = jnp.where(final_i[:, None], src, jnp.uint32(0))
+    union = jax.lax.reduce(union, jnp.uint32(0), jax.lax.bitwise_or, (0,))
+    counts = seg_popcount(union, tb.va)
+    return jnp.all((final.minv < 0) | (counts >= final.minv))
+
+
+# ---------------------------------------------------------------------------
+# stable-rank updates
+
+
+def _rank_after_increment(st: State, j: jax.Array) -> tuple[jax.Array, jax.Array]:
+    cnew = st.count[j] + 1
+    idx = jnp.arange(st.rank.shape[0])
+    geq = st.active & (st.count >= cnew) & (idx != j)
+    boundary = jnp.minimum(jnp.min(jnp.where(geq, st.rank, INF_I)), st.n_claims)
+    rank = st.rank - ((st.rank > st.rank[j]) & (st.rank < boundary)).astype(jnp.int32)
+    rank = rank.at[j].set(boundary - 1)
+    return rank, cnew
+
+
+def _rank_after_create(st: State, m: jax.Array) -> jax.Array:
+    geq2 = st.active & (st.count >= 2)
+    boundary = jnp.minimum(jnp.min(jnp.where(geq2, st.rank, INF_I)), st.n_claims)
+    rank = st.rank + (st.active & (st.rank >= boundary)).astype(jnp.int32)
+    return rank.at[m].set(boundary)
+
+
+# ---------------------------------------------------------------------------
+# record (topology.go:197 Record)
+
+
+def _eval_filters(filt: jax.Array, final: Reqs, tb: Tables, allow_wk) -> jax.Array:
+    """[G] bool — node_filter.matches(final reqs) over <=2 alternatives."""
+    G = filt.shape[0]
+    if tb.filter_reqs.mask.shape[0] == 0:
+        return jnp.ones(G, bool)
+    ok = jnp.zeros(G, bool)
+    trivial = jnp.all(filt < 0, axis=-1)
+    for a in range(filt.shape[1]):
+        alt = filt[:, a]
+        rows = _row(tb.filter_reqs, jnp.clip(alt, 0, None))
+        final_b = _broadcast_row(final, G)
+        got_strict = compat(final_b, rows, tb.va, False)
+        got_allow = compat(final_b, rows, tb.va, True)
+        got = jnp.where(allow_wk, got_allow, got_strict)
+        ok |= (alt >= 0) & got
+    return trivial | ok
+
+
+def _record(
+    st_v_cnt: jax.Array,
+    st_h_cnt: jax.Array,
+    final: Reqs,
+    slot_global: jax.Array,
+    allow_wk: jax.Array,
+    pred: jax.Array,
+    x: PodX,
+    tb: Tables,
+) -> tuple[jax.Array, jax.Array]:
+    # zone-family
+    segbits = _gather_bits(final.mask, tb.v_word, tb.v_bit)  # [Gv, VMAX]
+    exbits = _gather_bits(final.exmask, tb.v_word, tb.v_bit)
+    other_k = final.other[jnp.clip(tb.v_kid, 0, None)]  # [Gv]
+    popc = jnp.sum(segbits.astype(jnp.int32), axis=-1)
+    single = (popc == 1) & ~other_k
+    filt_ok = _eval_filters(tb.v_filt, final, tb, allow_wk)
+    add = jnp.where(
+        tb.v_anti[:, None],
+        jnp.where(other_k[:, None], exbits, segbits),
+        segbits & single[:, None],
+    )
+    gate_v = (pred & x.sel_v & filt_ok)[:, None]
+    v_cnt = st_v_cnt + (add & gate_v).astype(jnp.int32)
+
+    # hostname-family: forward groups count when selected + filter-matched;
+    # inverse groups count for their owners (topology.go:297)
+    filt_ok_h = _eval_filters(tb.h_filt, final, tb, allow_wk)
+    contrib = jnp.where(tb.h_inverse, x.own_h, x.sel_h & filt_ok_h)
+    h_cnt = st_h_cnt.at[:, slot_global].add((pred & contrib).astype(jnp.int32))
+    return v_cnt, h_cnt
+
+
+# ---------------------------------------------------------------------------
+# the scan step
+
+
+def _step(tb: Tables, st: State, x: PodX):
+    E = st.eavail.shape[0]
+    N = st.active.shape[0]
+    T = tb.tdaemon.shape[0]
+    I = tb.ialloc.shape[0]
+    IW = st.alive.shape[1]
+
+    nonempty_h = jnp.any(st.h_cnt > 0, axis=-1)  # [Gh]
+
+    # ======== existing nodes (exact, fixed order) ========
+    if E > 0:
+        merged_e = intersect(st.ereq, _broadcast_row(x.preq, E), tb.va)
+        compat_e = compat(st.ereq, _broadcast_row(x.preq, E), tb.va, False)
+        fits_e = jnp.all(st.eavail >= 0, axis=-1) & jnp.all(
+            x.prequests <= st.eavail, axis=-1
+        )
+        te_e = _eval_topology(merged_e, st.h_cnt[:, :E], nonempty_h, x, st, tb)
+        final_e = _apply_tighten(merged_e, te_e.tight, te_e.touched, tb.va)
+        cand_e = (
+            x.tol_e
+            & compat_e
+            & fits_e
+            & te_e.viable
+            & _topo_nonempty_ok(final_e, te_e.touched, tb.va)
+        )
+        found_e = jnp.any(cand_e) & x.valid
+        slot_e = jnp.argmin(jnp.where(cand_e, jnp.arange(E), INF_I))
+    else:
+        found_e = jnp.zeros((), bool)
+        slot_e = jnp.int32(0)
+        final_e = None
+        te_e = None
+
+    # ======== in-flight claims (screen + exact loop in rank order) ========
+    merged_c = intersect(st.creq, _broadcast_row(x.preq, N), tb.va)
+    compat_c = compat(st.creq, _broadcast_row(x.preq, N), tb.va, True)
+    te_c = _eval_topology(merged_c, st.h_cnt[:, E:], nonempty_h, x, st, tb)
+    final_c = _apply_tighten(merged_c, te_c.tight, te_c.touched, tb.va)
+    screen_fits = jnp.all(
+        st.crequests + x.prequests <= st.cmax_alloc, axis=-1
+    )
+    cand_c = (
+        st.active
+        & x.tol_t[jnp.clip(st.tmpl, 0, max(T - 1, 0))]
+        & compat_c
+        & te_c.viable
+        & _topo_nonempty_ok(final_c, te_c.touched, tb.va)
+        & screen_fits
+    )
+
+    def loop_cond(carry):
+        done, excluded, _ = carry
+        return ~done & jnp.any(cand_c & ~excluded)
+
+    def loop_body(carry):
+        done, excluded, _ = carry
+        live = cand_c & ~excluded
+        n = jnp.argmin(jnp.where(live, st.rank, INF_I))
+        final_n = _row(final_c, n)
+        alive_n = _unpack(st.alive[n], I)
+        total = st.crequests[n] + x.prequests
+        final_i = _type_filter(final_n, alive_n, total, tb)
+        ok = jnp.any(final_i) & _min_values_ok(final_n, final_i, tb)
+        return ok, excluded.at[n].set(~ok), jnp.where(ok, n, 0)
+
+    init = (jnp.zeros((), bool) | found_e | ~x.valid, jnp.zeros(N, bool), jnp.int32(0))
+    found_c, _, slot_c = jax.lax.while_loop(loop_cond, loop_body, init)
+    found_c = found_c & ~found_e & x.valid
+
+    # ======== new claim from a template (exact, weight order) ========
+    # only evaluated when nothing earlier accepted the pod (the common case
+    # at steady state is a claim hit, so skip the [T, I] filter work)
+    need_new = ~found_e & ~found_c & x.valid
+
+    def template_branch(_):
+        merged_t = intersect(tb.treq, _broadcast_row(x.preq, T), tb.va)
+        compat_t = compat(tb.treq, _broadcast_row(x.preq, T), tb.va, True)
+        new_slot_col = jax.lax.dynamic_slice_in_dim(
+            st.h_cnt, E + st.n_claims, 1, axis=1
+        )  # [Gh, 1] — fresh hostname: always zero, but stay general
+        te_t = _eval_topology(
+            merged_t,
+            jnp.broadcast_to(new_slot_col, (st.h_cnt.shape[0], T)),
+            nonempty_h,
+            x,
+            st,
+            tb,
+        )
+        final_t = _apply_tighten(merged_t, te_t.tight, te_t.touched, tb.va)
+        # limits filter (scheduler.go:851) then exact type filter per template
+        lim_ok = jnp.all(
+            ~tb.tlimit_def[:, None, :] | (tb.icap[None, :, :] <= st.trem[:, None, :]),
+            axis=-1,
+        )  # [T, I]
+        tmember = jax.vmap(lambda w: _unpack(w, I))(tb.ttypes)  # [T, I]
+        talive = tmember & (lim_ok | ~tb.thas_limits[:, None])
+        totals = tb.tdaemon + x.prequests  # [T, R]
+        t_final_i = jax.vmap(
+            lambda f, a, tot: _type_filter(f, a, tot, tb), in_axes=(0, 0, 0)
+        )(final_t, talive, totals)
+        t_minok = jax.vmap(lambda f, fi: _min_values_ok(f, fi, tb))(final_t, t_final_i)
+        viable_t = (
+            compat_t
+            & te_t.viable
+            & _topo_nonempty_ok(final_t, te_t.touched, tb.va)
+            & x.tol_t
+            & jnp.any(t_final_i, axis=-1)
+            & t_minok
+            & (st.n_claims < N)
+        )
+        slot = jnp.argmin(jnp.where(viable_t, jnp.arange(T), INF_I))
+        return jnp.any(viable_t), slot, _row(final_t, slot), t_final_i[slot]
+
+    def no_template(_):
+        zero_req = jax.tree.map(
+            lambda a: jnp.zeros(a.shape[1:], a.dtype), tb.treq
+        )
+        return jnp.zeros((), bool), jnp.int32(0), zero_req, jnp.zeros(I, bool)
+
+    found_t, slot_t, final_tn, alive_tn = jax.lax.cond(
+        need_new, template_branch, no_template, None
+    )
+    found_t = found_t & need_new
+
+    kind = jnp.where(
+        found_e,
+        KIND_EXISTING,
+        jnp.where(found_c, KIND_CLAIM, jnp.where(found_t, KIND_NEW, KIND_FAIL)),
+    )
+
+    # ======== apply updates ========
+    # --- existing ---
+    if E > 0:
+        pe = found_e
+        eavail = st.eavail.at[slot_e].add(
+            jnp.where(pe, -x.prequests, jnp.zeros_like(x.prequests))
+        )
+        ereq = _set_row(st.ereq, slot_e, _row(final_e, slot_e), pe)
+    else:
+        eavail, ereq = st.eavail, st.ereq
+
+    # --- claim add ---
+    pc = found_c
+    final_cn = _row(final_c, slot_c)
+    alive_cn = _type_filter(
+        final_cn,
+        _unpack(st.alive[slot_c], I),
+        st.crequests[slot_c] + x.prequests,
+        tb,
+    )
+    rank_inc, cnew = _rank_after_increment(st, slot_c)
+    creq = _set_row(st.creq, slot_c, final_cn, pc)
+    crequests = st.crequests.at[slot_c].add(
+        jnp.where(pc, x.prequests, jnp.zeros_like(x.prequests))
+    )
+    alive = st.alive.at[slot_c].set(
+        jnp.where(pc, _pack(alive_cn, IW), st.alive[slot_c])
+    )
+    new_max_c = jnp.max(
+        jnp.where(alive_cn[:, None], tb.ialloc, -INF_I), axis=0
+    )
+    cmax_alloc = st.cmax_alloc.at[slot_c].set(
+        jnp.where(pc, new_max_c, st.cmax_alloc[slot_c])
+    )
+    count = st.count.at[slot_c].set(jnp.where(pc, cnew, st.count[slot_c]))
+    rank = jnp.where(pc, rank_inc, st.rank)
+
+    # --- new claim ---
+    pn = found_t
+    m = st.n_claims
+    creq = _set_row(creq, m, final_tn, pn)
+    crequests = crequests.at[m].set(
+        jnp.where(pn, tb.tdaemon[slot_t] + x.prequests, crequests[m])
+    )
+    alive = alive.at[m].set(jnp.where(pn, _pack(alive_tn, IW), alive[m]))
+    new_max_t = jnp.max(jnp.where(alive_tn[:, None], tb.ialloc, -INF_I), axis=0)
+    cmax_alloc = cmax_alloc.at[m].set(jnp.where(pn, new_max_t, cmax_alloc[m]))
+    count = count.at[m].set(jnp.where(pn, 1, count[m]))
+    rank = jnp.where(pn, _rank_after_create(st, m), rank)
+    active = st.active.at[m].set(jnp.where(pn, True, st.active[m]))
+    tmpl = st.tmpl.at[m].set(jnp.where(pn, slot_t, st.tmpl[m]))
+    n_claims = st.n_claims + pn.astype(jnp.int32)
+    # subtractMax (scheduler.go:831) on the chosen template's pool limits
+    max_cap = jnp.max(jnp.where(alive_tn[:, None], tb.icap, 0), axis=0)
+    trem = st.trem.at[slot_t].add(
+        jnp.where(
+            pn & tb.thas_limits[slot_t],
+            -jnp.where(tb.tlimit_def[slot_t], max_cap, 0),
+            jnp.zeros_like(max_cap),
+        )
+    )
+
+    # --- topology record ---
+    if E > 0:
+        final_rec = _reqs_where(
+            kind == KIND_EXISTING,
+            _row(final_e, slot_e),
+            _reqs_where(kind == KIND_CLAIM, final_cn, final_tn),
+        )
+    else:
+        final_rec = _reqs_where(kind == KIND_CLAIM, final_cn, final_tn)
+    slot_global = jnp.where(
+        kind == KIND_EXISTING, slot_e, jnp.where(kind == KIND_CLAIM, E + slot_c, E + m)
+    )
+    allow_wk = kind != KIND_EXISTING
+    pred = (kind != KIND_FAIL) & x.valid
+    v_cnt, h_cnt = _record(
+        st.v_cnt, st.h_cnt, final_rec, slot_global, allow_wk, pred, x, tb
+    )
+
+    new_state = State(
+        active=active,
+        count=count,
+        rank=rank,
+        tmpl=tmpl,
+        creq=creq,
+        crequests=crequests,
+        alive=alive,
+        cmax_alloc=cmax_alloc,
+        n_claims=n_claims,
+        ereq=ereq,
+        eavail=eavail,
+        trem=trem,
+        v_cnt=v_cnt,
+        h_cnt=h_cnt,
+    )
+    out_slot = jnp.where(
+        kind == KIND_EXISTING,
+        slot_e,
+        jnp.where(kind == KIND_CLAIM, slot_c, jnp.where(kind == KIND_NEW, m, -1)),
+    )
+    return new_state, (kind, out_slot)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def solve_scan(tb: Tables, st: State, xs: PodX):
+    """Run the greedy pack over a pod batch; returns (state, kinds, slots)."""
+    step = functools.partial(_step, tb)
+    st, (kinds, slots) = jax.lax.scan(step, st, xs)
+    return st, kinds, slots

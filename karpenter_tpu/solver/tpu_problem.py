@@ -1,0 +1,585 @@
+"""Host-side encoding of a scheduling problem into dense tensors.
+
+The encoder consumes a *constructed oracle Scheduler* (karpenter_tpu.solver
+.oracle.Scheduler) so template filtering, daemon overhead, existing-node
+ordering, and topology-group construction are byte-identical to the oracle —
+the kernel then reproduces the oracle's per-pod decisions on tensors
+(reference call stack: scheduler.go:377 Solve / nodeclaim.go:114 CanAdd).
+
+Structural choices (SURVEY.md §7 "tensorization"):
+- hostname is not a vocab key: a node IS its hostname domain, so hostname
+  topologies count per node-slot (existing nodes then claim slots);
+- every other topology key counts per vocab value id ("zone-family");
+- instance types live in one global table; each template owns a bitmask of
+  it; each claim carries a surviving-types bitmask.
+
+Problems the tensor encoding can't express exactly raise UnsupportedBySolver
+and the caller falls back to the oracle (the hybrid dispatch documented in
+solver/tpu.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import NodeInclusionPolicy, Operator, Pod
+from karpenter_tpu.ops.encode import Reqs, empty_reqs, encode_requirements
+from karpenter_tpu.ops.vocab import ResourceTable, UnsupportedProblem, Vocab, WORD_BITS
+from karpenter_tpu.scheduling import Requirements, Taints
+from karpenter_tpu.scheduling.hostports import get_host_ports
+from karpenter_tpu.solver.oracle import Scheduler
+from karpenter_tpu.solver.topology import TopologyGroup, TopologyType
+from karpenter_tpu.utils import resources as res
+
+
+class UnsupportedBySolver(Exception):
+    """Problem uses a feature outside the tensor encoding; use the oracle."""
+
+
+# topology-slot kinds in the per-pod constraint table
+TOPO_NONE = 0
+TOPO_SPREAD_V = 1  # zone-family (vocab-key) spread
+TOPO_AFFINITY_V = 2
+TOPO_ANTI_V = 3
+TOPO_SPREAD_H = 4  # hostname-family
+TOPO_AFFINITY_H = 5
+TOPO_ANTI_H = 6
+
+# hard cap on per-pod constraint slots; the encoded table is sized to the
+# actual per-problem maximum (usually 1) so the kernel's unrolled topology
+# evaluation stays as small as the problem allows
+MAX_OWNED_TOPOLOGIES = 8
+MAX_FILTER_ALTERNATIVES = 2
+
+
+@dataclass
+class VGroup:
+    """Zone-family group: domain counts per vocab value id of its key."""
+
+    group: TopologyGroup
+    kid: int
+    skew: int
+    min_domains: int  # -1 = unset
+    # filter alternative indices into the stacked filter Reqs (-1 = none)
+    filt: tuple[int, int] = (-1, -1)
+
+
+@dataclass
+class HGroup:
+    """Hostname-family group: domain counts per node slot."""
+
+    group: TopologyGroup
+    skew: int
+    inverse: bool
+    filt: tuple[int, int] = (-1, -1)
+
+
+@dataclass
+class EncodedProblem:
+    vocab: Vocab
+    table: ResourceTable
+    scheduler: Scheduler  # the oracle object encoding was derived from
+
+    # dims
+    num_templates: int = 0
+    num_types: int = 0
+    num_existing: int = 0
+    max_claims: int = 0
+    vmax: int = 0
+
+    # templates [T]
+    treq: Optional[Reqs] = None
+    tdaemon: Optional[np.ndarray] = None  # [T, R] i32 initial claim requests
+    ttypes: Optional[np.ndarray] = None  # [T, IW] u32 type membership
+    tlimit_def: Optional[np.ndarray] = None  # [T, R] bool
+    tlimit_rem: Optional[np.ndarray] = None  # [T, R] i32
+    thas_limits: Optional[np.ndarray] = None  # [T] bool
+
+    # instance types [I]
+    ireq: Optional[Reqs] = None
+    ialloc: Optional[np.ndarray] = None  # [I, R] i32
+    icap: Optional[np.ndarray] = None  # [I, R] i32
+
+    # offerings (flattened) [O]
+    otype: Optional[np.ndarray] = None  # [O] i32 owning type
+    oword: Optional[np.ndarray] = None  # [O, 3] i32 word of zone/ct/rid bit (-1 = n/a)
+    obit: Optional[np.ndarray] = None  # [O, 3] i32
+
+    # existing nodes [E]
+    ereq: Optional[Reqs] = None
+    eavail: Optional[np.ndarray] = None  # [E, R] i32
+    ezone_seg: Optional[np.ndarray] = None  # [E, TW] — labels-derived, = ereq.mask
+
+    # zone-family topology groups [Gv]
+    vgroups: list[VGroup] = field(default_factory=list)
+    v_kid: Optional[np.ndarray] = None  # [Gv] i32
+    v_word: Optional[np.ndarray] = None  # [Gv, VMAX] i32 (global word; -1 pad)
+    v_bit: Optional[np.ndarray] = None  # [Gv, VMAX] i32
+    v_reg: Optional[np.ndarray] = None  # [Gv, VMAX] bool registered
+    v_cnt: Optional[np.ndarray] = None  # [Gv, VMAX] i32 initial counts
+    v_skew: Optional[np.ndarray] = None  # [Gv] i32
+    v_mindom: Optional[np.ndarray] = None  # [Gv] i32 (-1 unset)
+    v_filt: Optional[np.ndarray] = None  # [Gv, 2] i32 filter alt rows (-1 none)
+
+    # hostname-family topology groups [Gh] over slots [S = E + N]
+    hgroups: list[HGroup] = field(default_factory=list)
+    h_seed: list[tuple[int, int, int]] = field(default_factory=list)  # (g, slot, count)
+    h_skew: Optional[np.ndarray] = None  # [Gh] i32
+    h_filt: Optional[np.ndarray] = None  # [Gh, 2] i32
+
+    # stacked node-filter alternatives
+    filter_reqs: Optional[Reqs] = None  # [F]
+
+    # per-pod tables (built per solve() call)
+    pods: list[Pod] = field(default_factory=list)
+    preq: Optional[Reqs] = None  # [P]
+    prequests: Optional[np.ndarray] = None  # [P, R] i32
+    ptol_t: Optional[np.ndarray] = None  # [P, T] bool tolerates template taints
+    ptol_e: Optional[np.ndarray] = None  # [P, E] bool tolerates existing node taints
+    ptopo_kind: Optional[np.ndarray] = None  # [P, C] i32
+    ptopo_gid: Optional[np.ndarray] = None  # [P, C] i32
+    ptopo_sel: Optional[np.ndarray] = None  # [P, C] bool group selects pod
+    psel_v: Optional[np.ndarray] = None  # [P, Gv] bool selects (for record)
+    psel_h: Optional[np.ndarray] = None  # [P, Gh] bool selects (for record)
+    pinv_h: Optional[np.ndarray] = None  # [P, Gh] bool inverse-anti applies
+    pown_h: Optional[np.ndarray] = None  # [P, Gh] bool owner (inverse record)
+
+
+def _gate(cond: bool, why: str) -> None:
+    if cond:
+        raise UnsupportedBySolver(why)
+
+
+def _check_pod_supported(pod: Pod) -> None:
+    """Features the kernel doesn't encode yet -> oracle fallback. The
+    relaxation ladder (preferences.go:38) is the big one: it mutates pod
+    specs mid-solve, which would force host round-trips per relaxation."""
+    _gate(bool(pod.host_ports), "pod host ports")
+    _gate(bool(pod.volume_claims), "pod volume claims")
+    _gate(bool(pod.pod_affinity_preferred), "preferred pod affinity (relaxable)")
+    _gate(bool(pod.pod_anti_affinity_preferred), "preferred pod anti-affinity (relaxable)")
+    na = pod.node_affinity
+    if na is not None:
+        _gate(bool(na.preferred), "preferred node affinity (relaxable)")
+        _gate(len(na.required_terms) > 1, "multiple required node-affinity terms (relaxable)")
+    _gate(
+        any(t.when_unsatisfiable != "DoNotSchedule" for t in pod.topology_spread_constraints),
+        "ScheduleAnyway topology spread (relaxable)",
+    )
+    _gate(
+        well_known.HOSTNAME_LABEL_KEY in pod.node_selector,
+        "hostname node selector",
+    )
+    if na is not None:
+        for term in na.required_terms:
+            for e in term.match_expressions:
+                _gate(e.key == well_known.HOSTNAME_LABEL_KEY, "hostname affinity term")
+
+
+def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
+    """Build the full tensor problem from an oracle Scheduler + pod batch."""
+    _gate(scheduler.opts.reserved_capacity_enabled, "reserved capacity")
+    _gate(scheduler.opts.ignore_preferences, "PreferencePolicy=Ignore")  # TODO
+    for pod in pods:
+        _check_pod_supported(pod)
+
+    # the oracle handles the all-types-filtered-out case with per-pod errors
+    # (scheduler.go:489); zero templates would also give zero-width tensors
+    _gate(
+        not scheduler.templates,
+        "no templates survived nodepool requirement filtering",
+    )
+
+    p = EncodedProblem(vocab=Vocab(), table=ResourceTable(), scheduler=scheduler)
+    topo = scheduler.topology
+
+    # ---- vocab + resource universe ------------------------------------
+    vocab, table = p.vocab, p.table
+    all_types: list = []
+    type_index: dict[int, int] = {}
+    for nct in scheduler.templates:
+        vocab.observe_requirements(nct.requirements)
+        for it in nct.instance_type_options:
+            if id(it) not in type_index:
+                type_index[id(it)] = len(all_types)
+                all_types.append(it)
+    for it in all_types:
+        vocab.observe_requirements(it.requirements)
+        for o in it.offerings:
+            vocab.observe_requirements(o.requirements)
+        table.observe(it.allocatable())
+        table.observe(it.capacity)
+    for pod in pods:
+        reqs = Requirements.from_pod(pod)
+        for r in reqs.values():
+            if r.key != well_known.HOSTNAME_LABEL_KEY:
+                vocab.observe_requirement(r)
+        table.observe(pod.requests)
+        table.observe({res.PODS: 1000})
+    for node in scheduler.existing_nodes:
+        vocab.observe_labels(node.view.labels)
+        table.observe(node.remaining_resources)
+    for nct in scheduler.templates:
+        table.observe(scheduler.daemon_overhead[nct])
+        if nct.nodepool_name in scheduler.remaining_resources:
+            table.observe(scheduler.remaining_resources[nct.nodepool_name])
+    # topology group domains must be in vocab (they come from nodepool/type
+    # requirements or live node labels)
+    groups = list(topo.topology_groups.values()) + list(
+        topo.inverse_topology_groups.values()
+    )
+    for tg in groups:
+        if tg.key != well_known.HOSTNAME_LABEL_KEY:
+            for d in tg.domains:
+                vocab.observe_labels({tg.key: d})
+        for freq in tg.node_filter.requirements:
+            vocab.observe_requirements(freq)
+    try:
+        vocab.finalize()
+        table.finalize()
+    except UnsupportedProblem as e:
+        raise UnsupportedBySolver(str(e)) from e
+    _gate(vocab.total_words == 0, "empty requirement vocabulary")
+
+    # ---- templates + types --------------------------------------------
+    T = len(scheduler.templates)
+    I = len(all_types)
+    R = table.num_resources
+    p.num_templates, p.num_types = T, I
+    IW = max(1, (I + WORD_BITS - 1) // WORD_BITS)
+    try:
+        p.treq = encode_requirements(
+            vocab, [nct.requirements for nct in scheduler.templates]
+        )
+        p.tdaemon = np.stack(
+            [table.encode(scheduler.daemon_overhead[nct]) for nct in scheduler.templates]
+        ) if T else np.zeros((0, R), np.int32)
+        p.ireq = encode_requirements(vocab, [it.requirements for it in all_types])
+        p.ialloc = (
+            np.stack([table.encode(it.allocatable()) for it in all_types])
+            if I
+            else np.zeros((0, R), np.int32)
+        )
+        p.icap = (
+            np.stack([table.encode(it.capacity) for it in all_types])
+            if I
+            else np.zeros((0, R), np.int32)
+        )
+    except UnsupportedProblem as e:
+        raise UnsupportedBySolver(str(e)) from e
+
+    p.ttypes = np.zeros((T, IW), dtype=np.uint32)
+    for t, nct in enumerate(scheduler.templates):
+        for it in nct.instance_type_options:
+            i = type_index[id(it)]
+            p.ttypes[t, i // WORD_BITS] |= np.uint32(1 << (i % WORD_BITS))
+
+    p.tlimit_def = np.zeros((T, R), dtype=bool)
+    p.tlimit_rem = np.zeros((T, R), dtype=np.int32)
+    p.thas_limits = np.zeros(T, dtype=bool)
+    for t, nct in enumerate(scheduler.templates):
+        rem = scheduler.remaining_resources.get(nct.nodepool_name)
+        if rem is None:
+            continue
+        p.thas_limits[t] = True
+        for name, v in rem.items():
+            ri = table.index.get(name)
+            if ri is None:
+                raise UnsupportedBySolver(f"limit on unobserved resource {name!r}")
+            p.tlimit_def[t, ri] = True
+            # limits can go negative (over-subscribed pools); clamp encode
+            q, mod = divmod(int(v), table.scale[ri])
+            _gate(mod != 0, f"limit {name!r} not divisible by resource scale")
+            p.tlimit_rem[t, ri] = max(min(q, (1 << 30) - 1), -(1 << 30))
+
+    # ---- offerings -----------------------------------------------------
+    off_rows: list[tuple[int, list[int], list[int]]] = []
+    off_keys = (
+        well_known.TOPOLOGY_ZONE_LABEL_KEY,
+        well_known.CAPACITY_TYPE_LABEL_KEY,
+        well_known.RESERVATION_ID_LABEL_KEY,
+    )
+    for it in all_types:
+        i = type_index[id(it)]
+        for o in it.offerings:
+            if not o.available:
+                continue
+            words, bits = [], []
+            for key in off_keys:
+                r = o.requirements.get(key) if o.requirements.has(key) else None
+                if r is None:
+                    words.append(-1)
+                    bits.append(0)
+                    continue
+                _gate(
+                    r.complement or len(r.values) != 1,
+                    f"offering requirement {key!r} must be a single In value",
+                )
+                kid = vocab.key_index[key]
+                vid = vocab.value_index[kid][next(iter(r.values))]
+                words.append(vocab.word_offset[kid] + vid // WORD_BITS)
+                bits.append(vid % WORD_BITS)
+            for key in o.requirements.keys() - set(off_keys):
+                raise UnsupportedBySolver(f"offering requirement on {key!r}")
+            off_rows.append((i, words, bits))
+    O = len(off_rows)
+    p.otype = np.array([r[0] for r in off_rows], dtype=np.int32).reshape(O)
+    p.oword = np.array([r[1] for r in off_rows], dtype=np.int32).reshape(O, 3)
+    p.obit = np.array([r[2] for r in off_rows], dtype=np.int32).reshape(O, 3)
+
+    # ---- existing nodes ------------------------------------------------
+    E = len(scheduler.existing_nodes)
+    p.num_existing = E
+    p.ereq = encode_requirements(
+        vocab, [n.requirements for n in scheduler.existing_nodes]
+    )
+    try:
+        p.eavail = (
+            np.stack(
+                [table.encode(n.remaining_resources) for n in scheduler.existing_nodes]
+            )
+            if E
+            else np.zeros((0, R), np.int32)
+        )
+    except UnsupportedProblem as e:
+        raise UnsupportedBySolver(str(e)) from e
+
+    # ---- topology groups ----------------------------------------------
+    filter_sets: list[Requirements] = []
+
+    def encode_filter(tg: TopologyGroup) -> tuple[int, int]:
+        nf = tg.node_filter
+        _gate(
+            nf.taint_policy == NodeInclusionPolicy.HONOR,
+            "nodeTaintsPolicy=Honor topology filter",
+        )
+        if nf.affinity_policy != NodeInclusionPolicy.HONOR or not nf.requirements:
+            return (-1, -1)
+        # a filter of one empty Requirements matches everything
+        alts = [r for r in nf.requirements if len(r) > 0]
+        if not alts:
+            return (-1, -1)
+        _gate(
+            len(alts) > MAX_FILTER_ALTERNATIVES,
+            "too many topology node-filter alternatives",
+        )
+        out = []
+        for alt in alts:
+            _gate(
+                alt.has(well_known.HOSTNAME_LABEL_KEY),
+                "hostname in topology node filter",
+            )
+            filter_sets.append(alt)
+            out.append(len(filter_sets) - 1)
+        while len(out) < MAX_FILTER_ALTERNATIVES:
+            out.append(-1)
+        return tuple(out)  # type: ignore[return-value]
+
+    group_vid: dict[int, tuple[str, int]] = {}  # id(tg) -> (family, index)
+    for tg in topo.topology_groups.values():
+        if tg.key == well_known.HOSTNAME_LABEL_KEY:
+            group_vid[id(tg)] = ("h", len(p.hgroups))
+            p.hgroups.append(
+                HGroup(tg, _clip_skew(tg.max_skew), inverse=False, filt=encode_filter(tg))
+            )
+        else:
+            kid = vocab.key_index.get(tg.key)
+            _gate(kid is None, f"topology key {tg.key!r} has no vocab values")
+            _gate(
+                tg.type != TopologyType.SPREAD and tg.min_domains is not None,
+                "minDomains on non-spread group",
+            )
+            group_vid[id(tg)] = ("v", len(p.vgroups))
+            p.vgroups.append(
+                VGroup(
+                    tg,
+                    kid,
+                    _clip_skew(tg.max_skew),
+                    -1 if tg.min_domains is None else tg.min_domains,
+                    encode_filter(tg),
+                )
+            )
+    for tg in topo.inverse_topology_groups.values():
+        _gate(
+            tg.key != well_known.HOSTNAME_LABEL_KEY,
+            f"inverse anti-affinity on key {tg.key!r}",
+        )
+        group_vid[id(tg)] = ("h", len(p.hgroups))
+        p.hgroups.append(HGroup(tg, _clip_skew(tg.max_skew), inverse=True))
+
+    Gv, Gh = len(p.vgroups), len(p.hgroups)
+    p.vmax = VMAX = max(
+        [len(vocab.values[g.kid]) for g in p.vgroups], default=1
+    )
+    p.v_kid = np.array([g.kid for g in p.vgroups], dtype=np.int32).reshape(Gv)
+    p.v_skew = np.array([g.skew for g in p.vgroups], dtype=np.int32).reshape(Gv)
+    p.v_mindom = np.array([g.min_domains for g in p.vgroups], dtype=np.int32).reshape(Gv)
+    p.v_filt = np.array([g.filt for g in p.vgroups], dtype=np.int32).reshape(Gv, 2)
+    p.v_word = np.full((Gv, VMAX), -1, dtype=np.int32)
+    p.v_bit = np.zeros((Gv, VMAX), dtype=np.int32)
+    p.v_reg = np.zeros((Gv, VMAX), dtype=bool)
+    p.v_cnt = np.zeros((Gv, VMAX), dtype=np.int32)
+    for g, vg in enumerate(p.vgroups):
+        kid = vg.kid
+        nvals = len(vocab.values[kid])
+        for vid in range(nvals):
+            p.v_word[g, vid] = vocab.word_offset[kid] + vid // WORD_BITS
+            p.v_bit[g, vid] = vid % WORD_BITS
+        for d, c in vg.group.domains.items():
+            vid = vocab.value_index[kid].get(d)
+            if vid is None:
+                raise UnsupportedBySolver(f"domain {d!r} missing from vocab")
+            p.v_reg[g, vid] = True
+            p.v_cnt[g, vid] = c
+
+    p.h_skew = np.array([g.skew for g in p.hgroups], dtype=np.int32).reshape(Gh)
+    p.h_filt = np.array(
+        [g.filt for g in p.hgroups], dtype=np.int32
+    ).reshape(Gh, 2) if Gh else np.zeros((0, 2), np.int32)
+    # the full h_cnt is sized at solve time (needs max_claims); seed counts
+    # for existing-node hostnames here
+    host_slot = {
+        n.view.hostname: e for e, n in enumerate(scheduler.existing_nodes)
+    }
+    for g, hg in enumerate(p.hgroups):
+        for d, c in hg.group.domains.items():
+            if c == 0:
+                continue
+            slot = host_slot.get(d)
+            if slot is None:
+                # counts on hostnames we don't model (e.g. unmanaged nodes
+                # outside the state-node set) can't be attributed to a slot
+                raise UnsupportedBySolver(
+                    f"hostname domain {d!r} with count outside known nodes"
+                )
+            p.h_seed.append((g, slot, c))
+
+    p.filter_reqs = (
+        encode_requirements(vocab, filter_sets)
+        if filter_sets
+        else empty_reqs(vocab, (0,))
+    )
+
+    # ---- pods ----------------------------------------------------------
+    _encode_pods(p, pods, group_vid)
+    return p
+
+
+def _clip_skew(skew: int) -> int:
+    return int(min(skew, (1 << 30)))
+
+
+def _encode_pods(
+    p: EncodedProblem, pods: list[Pod], group_vid: dict[int, tuple[str, int]]
+) -> None:
+    vocab, table, scheduler = p.vocab, p.table, p.scheduler
+    topo = scheduler.topology
+    P = len(pods)
+    T, E = p.num_templates, p.num_existing
+    Gv, Gh = len(p.vgroups), len(p.hgroups)
+    p.pods = pods
+
+    preqs = []
+    p.prequests = np.zeros((P, table.num_resources), dtype=np.int32)
+    for i, pod in enumerate(pods):
+        reqs = Requirements.from_pod(pod)
+        reqs.pop(well_known.HOSTNAME_LABEL_KEY)
+        preqs.append(reqs)
+        p.prequests[i] = table.encode(res.requests_for_pods([pod]))
+    try:
+        p.preq = encode_requirements(vocab, preqs)
+    except UnsupportedProblem as e:
+        raise UnsupportedBySolver(str(e)) from e
+
+    # taint toleration (static per pod x template/node)
+    tol_cache: dict[tuple, bool] = {}
+
+    def tolerates(taints, pod) -> bool:
+        key = (
+            tuple((t.key, t.value, t.effect) for t in taints),
+            tuple(
+                (t.key, t.operator, t.value, t.effect) for t in pod.tolerations
+            ),
+        )
+        got = tol_cache.get(key)
+        if got is None:
+            got = Taints(taints).tolerates_pod(pod) is None
+            tol_cache[key] = got
+        return got
+
+    p.ptol_t = np.zeros((P, T), dtype=bool)
+    for t, nct in enumerate(scheduler.templates):
+        for i, pod in enumerate(pods):
+            p.ptol_t[i, t] = tolerates(nct.taints, pod)
+    p.ptol_e = np.zeros((P, E), dtype=bool)
+    for e, node in enumerate(scheduler.existing_nodes):
+        for i, pod in enumerate(pods):
+            p.ptol_e[i, e] = tolerates(node.cached_taints, pod)
+
+    # host-port conflicts are gated off; see _check_pod_supported
+    for pod in pods:
+        assert not get_host_ports(pod)
+
+    # topology ownership tables
+    kind_of = {
+        ("v", TopologyType.SPREAD): TOPO_SPREAD_V,
+        ("v", TopologyType.POD_AFFINITY): TOPO_AFFINITY_V,
+        ("v", TopologyType.POD_ANTI_AFFINITY): TOPO_ANTI_V,
+        ("h", TopologyType.SPREAD): TOPO_SPREAD_H,
+        ("h", TopologyType.POD_AFFINITY): TOPO_AFFINITY_H,
+        ("h", TopologyType.POD_ANTI_AFFINITY): TOPO_ANTI_H,
+    }
+    owned_by_uid: dict[str, list[TopologyGroup]] = {}
+    for tg in topo.topology_groups.values():
+        for uid in tg.owners:
+            owned_by_uid.setdefault(uid, []).append(tg)
+    C = max([len(owned_by_uid.get(pod.uid, ())) for pod in pods], default=0)
+    C = max(1, C)
+    _gate(C > MAX_OWNED_TOPOLOGIES, "pod owns too many topology constraints")
+    p.ptopo_kind = np.zeros((P, C), dtype=np.int32)
+    p.ptopo_gid = np.zeros((P, C), dtype=np.int32)
+    p.ptopo_sel = np.zeros((P, C), dtype=bool)
+    p.psel_v = np.zeros((P, Gv), dtype=bool)
+    p.psel_h = np.zeros((P, Gh), dtype=bool)
+    p.pinv_h = np.zeros((P, Gh), dtype=bool)
+    p.pown_h = np.zeros((P, Gh), dtype=bool)
+
+    # selects() memoized by (namespace, labels fingerprint)
+    sel_cache: dict[tuple, np.ndarray] = {}
+
+    def selects_row(pod: Pod) -> tuple[np.ndarray, np.ndarray]:
+        key = (pod.namespace, tuple(sorted(pod.metadata.labels.items())))
+        got = sel_cache.get(key)
+        if got is None:
+            vrow = np.array(
+                [vg.group.selects(pod) for vg in p.vgroups], dtype=bool
+            )
+            hrow = np.array(
+                [hg.group.selects(pod) for hg in p.hgroups], dtype=bool
+            )
+            got = (vrow, hrow)
+            sel_cache[key] = got
+        return got
+
+    for i, pod in enumerate(pods):
+        vrow, hrow = selects_row(pod)
+        p.psel_v[i] = vrow
+        p.psel_h[i] = hrow
+        slot = 0
+        for tg in owned_by_uid.get(pod.uid, ()):
+            fam, gid = group_vid[id(tg)]
+            p.ptopo_kind[i, slot] = kind_of[(fam, tg.type)]
+            p.ptopo_gid[i, slot] = gid
+            p.ptopo_sel[i, slot] = vrow[gid] if fam == "v" else hrow[gid]
+            slot += 1
+        for g, hg in enumerate(p.hgroups):
+            if not hg.inverse:
+                continue
+            # inverse groups act as anti-affinity on any pod they select
+            # (topology.go:528) and record for their owners
+            p.pinv_h[i, g] = hrow[g]
+            p.pown_h[i, g] = hg.group.is_owned_by(pod.uid)

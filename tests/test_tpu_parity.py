@@ -1,0 +1,350 @@
+"""Oracle/TPU parity: the batched solver must make bit-identical decisions.
+
+Every scenario runs the same problem through the oracle Scheduler and the
+TpuScheduler and compares the full assignment (pod -> node partition), the
+surviving instance types per claim, and accumulated requests. The scenarios
+cover the reference benchmark's pod classes (scheduling_benchmark_test.go:257
+makeDiversePods) plus existing nodes, limits, weights, taints, and minValues.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import (
+    NodeSelectorRequirement,
+    Operator,
+    Taint,
+    TaintEffect,
+    Toleration,
+)
+from karpenter_tpu.cloudprovider.fake import instance_types as fake_types
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.solver.nodes import StateNodeView
+from karpenter_tpu.solver.oracle import Scheduler, SchedulerOptions
+from karpenter_tpu.solver.topology import Topology
+from karpenter_tpu.solver.tpu import TpuScheduler
+from karpenter_tpu.solver.tpu_problem import UnsupportedBySolver
+from karpenter_tpu.testing import fixtures
+from karpenter_tpu.utils import resources as res
+
+
+def run_both(make_problem, options=None):
+    """Build the problem twice (fresh Topology per scheduler) and solve."""
+    results = []
+    for cls in (Scheduler, TpuScheduler):
+        node_pools, its_by_pool, pods, views, daemons = make_problem()
+        topo = Topology(
+            node_pools,
+            its_by_pool,
+            pods,
+            state_node_views=views,
+            ignore_preferences=bool(options and options.ignore_preferences),
+        )
+        s = cls(node_pools, its_by_pool, topo, views, daemons, options)
+        results.append((s.solve(pods), pods))
+    return results
+
+
+def assert_parity(results, allow_errors=False):
+    (orc, orc_pods), (tpu, tpu_pods) = results
+    orc_names = {p.uid: p.name for p in orc_pods}
+    tpu_names = {p.uid: p.name for p in tpu_pods}
+    assert {orc_names[u] for u in orc.pod_errors} == {
+        tpu_names[u] for u in tpu.pod_errors
+    }
+    if not allow_errors:
+        assert not orc.pod_errors, orc.pod_errors
+    # node partition by pod-name sets
+    def parts(r):
+        out = []
+        for c in r.new_node_claims:
+            out.append(("new", tuple(sorted(p.name for p in c.pods))))
+        for n in r.existing_nodes:
+            if n.pods:
+                out.append((n.name, tuple(sorted(p.name for p in n.pods))))
+        return sorted(out)
+
+    assert parts(orc) == parts(tpu)
+    # per-claim surviving instance types + requests
+    def claim_map(r):
+        return {
+            tuple(sorted(p.name for p in c.pods)): (
+                [it.name for it in c.instance_type_options],
+                dict(c.requests),
+                c.template.nodepool_name,
+            )
+            for c in r.new_node_claims
+        }
+
+    assert claim_map(orc) == claim_map(tpu)
+
+
+def kwok_problem(n_pods, maker=None, seed=42, pools=None, views=None, daemons=None):
+    def make():
+        fixtures.reset_rng(seed)
+        its = construct_instance_types()
+        node_pools = pools() if pools else [fixtures.node_pool(name="default")]
+        pods = (maker or fixtures.make_diverse_pods)(n_pods)
+        return (
+            node_pools,
+            {np.name: its for np in node_pools},
+            pods,
+            views() if views else None,
+            daemons() if daemons else None,
+        )
+
+    return make
+
+
+def test_generic_pods():
+    assert_parity(run_both(kwok_problem(80, fixtures.make_generic_pods)))
+
+
+def test_diverse_mix():
+    assert_parity(run_both(kwok_problem(150)))
+
+
+def test_zonal_spread():
+    assert_parity(
+        run_both(
+            kwok_problem(
+                60,
+                lambda n: fixtures.make_topology_spread_pods(
+                    n, well_known.TOPOLOGY_ZONE_LABEL_KEY
+                ),
+            )
+        )
+    )
+
+
+def test_hostname_spread():
+    assert_parity(
+        run_both(
+            kwok_problem(
+                60,
+                lambda n: fixtures.make_topology_spread_pods(
+                    n, well_known.HOSTNAME_LABEL_KEY
+                ),
+            )
+        )
+    )
+
+
+def test_zonal_self_affinity():
+    assert_parity(
+        run_both(
+            kwok_problem(
+                60,
+                lambda n: fixtures.make_pod_affinity_pods(
+                    n, well_known.TOPOLOGY_ZONE_LABEL_KEY
+                ),
+            )
+        )
+    )
+
+
+def test_hostname_anti_affinity():
+    assert_parity(
+        run_both(
+            kwok_problem(
+                40,
+                lambda n: fixtures.make_pod_anti_affinity_pods(
+                    n, well_known.HOSTNAME_LABEL_KEY
+                ),
+            )
+        )
+    )
+
+
+def test_nodepool_weights_and_requirements():
+    def pools():
+        return [
+            fixtures.node_pool(
+                name="small",
+                weight=10,
+                requirements=[
+                    NodeSelectorRequirement(
+                        well_known.TOPOLOGY_ZONE_LABEL_KEY,
+                        Operator.IN,
+                        ["test-zone-a", "test-zone-b"],
+                    )
+                ],
+            ),
+            fixtures.node_pool(name="big", weight=1),
+        ]
+
+    assert_parity(run_both(kwok_problem(60, pools=pools)))
+
+
+def test_nodepool_limits():
+    def pools():
+        return [
+            fixtures.node_pool(name="capped", weight=5, limits={"cpu": "30"}),
+            fixtures.node_pool(name="overflow"),
+        ]
+
+    assert_parity(run_both(kwok_problem(80, pools=pools)))
+
+
+def test_taints_and_tolerations():
+    def pools():
+        return [
+            fixtures.node_pool(
+                name="tainted",
+                weight=10,
+                taints=[Taint("dedicated", TaintEffect.NO_SCHEDULE, "gpu")],
+            ),
+            fixtures.node_pool(name="open"),
+        ]
+
+    def maker(n):
+        fixtures.reset_rng(7)
+        pods = fixtures.make_generic_pods(n)
+        for i, p in enumerate(pods):
+            if i % 3 == 0:
+                p.tolerations.append(
+                    Toleration(
+                        key="dedicated",
+                        operator="Equal",
+                        value="gpu",
+                        effect=TaintEffect.NO_SCHEDULE,
+                    )
+                )
+        return pods
+
+    assert_parity(run_both(kwok_problem(45, maker, pools=pools)))
+
+
+def test_existing_nodes():
+    def views():
+        its = construct_instance_types()
+        it = its[0]
+        out = []
+        for i in range(4):
+            out.append(
+                StateNodeView(
+                    name=f"existing-{i}",
+                    node_labels={well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a"},
+                    labels={
+                        well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a",
+                        well_known.INSTANCE_TYPE_LABEL_KEY: it.name,
+                        well_known.NODEPOOL_LABEL_KEY: "default",
+                    },
+                    available=dict(it.allocatable()),
+                    capacity=dict(it.capacity),
+                    initialized=True,
+                )
+            )
+        return out
+
+    assert_parity(run_both(kwok_problem(40, fixtures.make_generic_pods, views=views)))
+
+
+def test_pod_node_selector():
+    def maker(n):
+        fixtures.reset_rng(13)
+        pods = fixtures.make_generic_pods(n)
+        for i, p in enumerate(pods):
+            if i % 2 == 0:
+                p.node_selector[well_known.TOPOLOGY_ZONE_LABEL_KEY] = "test-zone-b"
+        return pods
+
+    assert_parity(run_both(kwok_problem(30, maker)))
+
+
+def test_unschedulable_pod_reports_error():
+    def maker(n):
+        fixtures.reset_rng(17)
+        pods = fixtures.make_generic_pods(n)
+        pods[0].requests = res.parse_list({"cpu": "10000"})  # fits nothing
+        return pods
+
+    assert_parity(run_both(kwok_problem(10, maker)), allow_errors=True)
+
+
+def test_min_values():
+    def pools():
+        return [
+            fixtures.node_pool(
+                name="flexible",
+                requirements=[
+                    NodeSelectorRequirement(
+                        well_known.INSTANCE_TYPE_LABEL_KEY,
+                        Operator.EXISTS,
+                        min_values=10,
+                    )
+                ],
+            )
+        ]
+
+    assert_parity(run_both(kwok_problem(25, fixtures.make_generic_pods, pools=pools)))
+
+
+def test_min_values_undefined_key_not_counted():
+    """Regression (review finding): instance types that don't define a
+    minValues key contribute NO values — an Exists encoding must not count
+    the full vocab. Both solvers must fail these pods identically."""
+    from karpenter_tpu.cloudprovider.types import InstanceTypes
+    from karpenter_tpu.testing.fixtures import pod
+
+    def make():
+        fixtures.reset_rng(3)
+        its = construct_instance_types(sizes=[4])
+        # two values exist across types (so the template survives init with
+        # minValues=2), but pods select custom=a: the claim's surviving set
+        # is {custom=a types} ∪ {undefined types} -> distinct values {a}
+        from karpenter_tpu.scheduling import Requirement as Req
+
+        its[0].requirements.add(Req("example.com/custom", Operator.IN, ["a"]))
+        its[1].requirements.add(Req("example.com/custom", Operator.IN, ["b"]))
+        pools = [
+            fixtures.node_pool(
+                name="default",
+                requirements=[
+                    NodeSelectorRequirement(
+                        "example.com/custom", Operator.EXISTS, min_values=2
+                    )
+                ],
+            )
+        ]
+        pods = fixtures.make_generic_pods(6)
+        for p in pods:
+            p.node_selector["example.com/custom"] = "a"
+        return pools, {"default": InstanceTypes(its)}, pods, None, None
+
+    assert_parity(run_both(make), allow_errors=True)
+
+
+def test_fallback_when_no_templates_survive():
+    """All instance types filtered out by nodepool requirements -> the
+    encoder must raise UnsupportedBySolver (oracle fallback), not crash."""
+    fixtures.reset_rng(5)
+    its = construct_instance_types(sizes=[2])
+    pools = [
+        fixtures.node_pool(
+            name="default",
+            requirements=[
+                NodeSelectorRequirement(
+                    well_known.TOPOLOGY_ZONE_LABEL_KEY, Operator.IN, ["no-such-zone"]
+                )
+            ],
+        )
+    ]
+    pods = fixtures.make_generic_pods(4)
+    topo = Topology(pools, {"default": its}, pods)
+    t = TpuScheduler(pools, {"default": its}, topo)
+    with pytest.raises(UnsupportedBySolver):
+        t.solve(pods)
+
+
+def test_fallback_on_preferences():
+    fixtures.reset_rng(42)
+    its = fake_types(10)
+    np_ = fixtures.node_pool(name="default")
+    pods = fixtures.make_preference_pods(5)
+    topo = Topology([np_], {"default": its}, pods)
+    t = TpuScheduler([np_], {"default": its}, topo)
+    with pytest.raises(UnsupportedBySolver):
+        t.solve(pods)
