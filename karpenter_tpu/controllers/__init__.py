@@ -1,0 +1,15 @@
+"""The control plane (reference pkg/controllers): a set of controllers
+sharing an in-memory cluster-state cache, driving the scheduling core, and
+talking to a cloud provider.
+
+The reference's distributed-coordination backend is the kube-apiserver
+(watch/list/update with optimistic concurrency, SURVEY.md §5.8). This
+framework keeps that architecture with `SimKube` as the API store —
+in-process here; the same controller code runs against a real apiserver by
+swapping the store implementation. The solve plane (karpenter_tpu.solver)
+receives problems through the HybridScheduler dispatch.
+"""
+
+from karpenter_tpu.controllers.kube import Conflict, FakeClock, RealClock, SimKube
+
+__all__ = ["SimKube", "Conflict", "FakeClock", "RealClock"]

@@ -1,0 +1,193 @@
+"""SimKube: the in-memory API store standing in for the kube-apiserver.
+
+Semantics mirrored from Kubernetes because the reference's correctness
+leans on them (reference pkg/operator/operator.go, controller-runtime):
+- optimistic concurrency: update() rejects stale resource_version (the
+  conflict-requeue pattern in disruption/controller.go:146)
+- finalizers: delete() only marks deletion_timestamp while finalizers
+  remain; objects vanish when the last finalizer is removed
+- watch: subscribers get (event_type, kind, obj) synchronously on commit —
+  the informer layer (controllers/state.py wire_informers) builds the
+  cluster cache from these, exactly like the reference's informer
+  controllers (pkg/controllers/state/informer/)
+
+Stored kinds are the framework's dataclasses (karpenter_tpu.api.objects):
+Pod, Node, NodeClaim, NodePool, DaemonSet.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import time as time_mod
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+from karpenter_tpu.api.objects import Node, Pod
+
+ADDED = "added"
+UPDATED = "updated"
+DELETED = "deleted"
+
+
+class Conflict(Exception):
+    """Optimistic-concurrency failure (HTTP 409 equivalent)."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+class RealClock:
+    def now(self) -> float:
+        return time_mod.monotonic()
+
+
+class FakeClock:
+    """Manually advanced clock for deterministic controller tests (the
+    reference uses k8s.io/utils/clock/testing the same way)."""
+
+    def __init__(self, start: float = 1000.0):
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> None:
+        self._now += seconds
+
+
+@dataclass
+class DaemonSet:
+    """Minimal DaemonSet: the provisioner only needs the pod template for
+    daemon overhead computation (reference provisioner.go:477)."""
+
+    name: str
+    pod_template: Pod = field(default_factory=Pod)
+
+
+Subscriber = Callable[[str, str, object], None]
+
+
+class SimKube:
+    def __init__(self) -> None:
+        self._stores: dict[str, dict[str, object]] = {}
+        self._version = itertools.count(1)
+        self._subscribers: list[Subscriber] = []
+
+    # -- watch ------------------------------------------------------------
+
+    def subscribe(self, fn: Subscriber) -> None:
+        self._subscribers.append(fn)
+
+    def _emit(self, event: str, kind: str, obj) -> None:
+        for fn in self._subscribers:
+            fn(event, kind, obj)
+
+    # -- helpers ----------------------------------------------------------
+
+    @staticmethod
+    def _name(obj) -> str:
+        meta = getattr(obj, "metadata", None)
+        return meta.name if meta is not None else obj.name
+
+    def _store(self, kind: str) -> dict[str, object]:
+        return self._stores.setdefault(kind, {})
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, kind: str, obj):
+        store = self._store(kind)
+        name = self._name(obj)
+        if name in store:
+            raise AlreadyExists(f"{kind}/{name}")
+        obj = copy.deepcopy(obj)
+        obj.metadata.resource_version = next(self._version)
+        store[name] = obj
+        self._emit(ADDED, kind, copy.deepcopy(obj))
+        return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str):
+        obj = self._store(kind).get(name)
+        if obj is None:
+            raise NotFound(f"{kind}/{name}")
+        return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, name: str):
+        obj = self._store(kind).get(name)
+        return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, kind: str, filter: Optional[Callable[[object], bool]] = None):
+        out = [copy.deepcopy(o) for o in self._store(kind).values()]
+        if filter is not None:
+            out = [o for o in out if filter(o)]
+        return out
+
+    def update(self, kind: str, obj):
+        """Optimistic-concurrency update; finalizer-clearing completes a
+        pending delete."""
+        store = self._store(kind)
+        name = self._name(obj)
+        current = store.get(name)
+        if current is None:
+            raise NotFound(f"{kind}/{name}")
+        if obj.metadata.resource_version != current.metadata.resource_version:
+            raise Conflict(
+                f"{kind}/{name}: version {obj.metadata.resource_version} != "
+                f"{current.metadata.resource_version}"
+            )
+        obj = copy.deepcopy(obj)
+        obj.metadata.resource_version = next(self._version)
+        if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+            del store[name]
+            self._emit(DELETED, kind, copy.deepcopy(obj))
+            return None
+        store[name] = obj
+        self._emit(UPDATED, kind, copy.deepcopy(obj))
+        return copy.deepcopy(obj)
+
+    def delete(self, kind: str, name: str, now: float = 0.0):
+        store = self._store(kind)
+        current = store.get(name)
+        if current is None:
+            raise NotFound(f"{kind}/{name}")
+        if current.metadata.finalizers:
+            if current.metadata.deletion_timestamp is None:
+                current.metadata.deletion_timestamp = now
+                current.metadata.resource_version = next(self._version)
+                self._emit(UPDATED, kind, copy.deepcopy(current))
+            return None
+        del store[name]
+        self._emit(DELETED, kind, copy.deepcopy(current))
+        return None
+
+    # -- typed conveniences ----------------------------------------------
+
+    def bind(self, pod_name: str, node_name: str) -> None:
+        """The kube-scheduler binding equivalent."""
+        pod = self._store("Pod").get(pod_name)
+        if pod is None:
+            raise NotFound(f"Pod/{pod_name}")
+        pod.node_name = node_name
+        pod.metadata.resource_version = next(self._version)
+        self._emit(UPDATED, "Pod", copy.deepcopy(pod))
+
+    def pending_pods(self) -> list[Pod]:
+        return self.list(
+            "Pod",
+            lambda p: not p.node_name
+            and p.metadata.deletion_timestamp is None
+            and not p.scheduling_gates,
+        )
+
+    def ready_nodes(self) -> list[Node]:
+        return self.list(
+            "Node",
+            lambda n: n.ready
+            and not n.unschedulable
+            and n.metadata.deletion_timestamp is None,
+        )
