@@ -74,10 +74,11 @@ Subscriber = Callable[[str, str, object], None]
 
 
 class SimKube:
-    def __init__(self) -> None:
+    def __init__(self, clock=None) -> None:
         self._stores: dict[str, dict[str, object]] = {}
         self._version = itertools.count(1)
         self._subscribers: list[Subscriber] = []
+        self.clock = clock if clock is not None else RealClock()
 
     # -- watch ------------------------------------------------------------
 
@@ -150,14 +151,16 @@ class SimKube:
         self._emit(UPDATED, kind, copy.deepcopy(obj))
         return copy.deepcopy(obj)
 
-    def delete(self, kind: str, name: str, now: float = 0.0):
+    def delete(self, kind: str, name: str, now: Optional[float] = None):
         store = self._store(kind)
         current = store.get(name)
         if current is None:
             raise NotFound(f"{kind}/{name}")
         if current.metadata.finalizers:
             if current.metadata.deletion_timestamp is None:
-                current.metadata.deletion_timestamp = now
+                current.metadata.deletion_timestamp = (
+                    self.clock.now() if now is None else now
+                )
                 current.metadata.resource_version = next(self._version)
                 self._emit(UPDATED, kind, copy.deepcopy(current))
             return None

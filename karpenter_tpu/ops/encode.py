@@ -89,6 +89,15 @@ def _encode_one(vocab: Vocab, out: Reqs, b: int, r: Requirement) -> None:
         target[vid // WORD_BITS] |= np.uint32(1 << (vid % WORD_BITS))
 
     if r.complement:
+        # NotIn combined with Gt/Lt on the same key: the mask encoding drops
+        # bound-failing excluded values, but the reference's minValues
+        # distinct-value union keeps them (requirement.go Values()) — gate
+        # rather than diverge
+        if r.values and (r.greater_than is not None or r.less_than is not None):
+            raise UnsupportedProblem(
+                f"NotIn with Gt/Lt bounds on key {r.key!r} (minValues "
+                "distinct-count would diverge from the reference)"
+            )
         # NotIn excluded values must be in the vocab or the notin bit (and
         # with it the NotIn/DoesNotExist tolerance rule) silently flips
         for v in r.values:

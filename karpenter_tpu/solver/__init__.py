@@ -7,9 +7,18 @@ TPU solver behind a common interface.
   benchmarks.
 - `topology`: topology-spread / pod-affinity / anti-affinity tracking.
 - `tpu`: the batched JAX solver (see karpenter_tpu.ops for the kernels).
+- `hybrid`: the HybridScheduler dispatch — TPU path with oracle fallback on
+  UnsupportedBySolver; the entry point for controllers and benchmarks.
 """
 
+from karpenter_tpu.solver.hybrid import HybridScheduler
 from karpenter_tpu.solver.oracle import Results, Scheduler, SchedulerOptions
 from karpenter_tpu.solver.topology import Topology
 
-__all__ = ["Results", "Scheduler", "SchedulerOptions", "Topology"]
+__all__ = [
+    "HybridScheduler",
+    "Results",
+    "Scheduler",
+    "SchedulerOptions",
+    "Topology",
+]

@@ -1,0 +1,92 @@
+"""HybridScheduler: the Solver dispatch — TPU first, oracle fallback.
+
+This is the single entry point callers (provisioner, disruption simulation,
+benchmarks) use. It mirrors the reference's Scheduler.Solve surface
+(/root/reference/pkg/controllers/provisioning/scheduling/scheduler.go:377)
+while routing the computation:
+
+- The TPU path (karpenter_tpu.solver.tpu.TpuScheduler) encodes the problem
+  into dense tensors and packs pods in a jitted scan. Problems outside the
+  tensor encoding raise UnsupportedBySolver *at encode time*, before any
+  state is mutated.
+- On UnsupportedBySolver the dispatch falls back to the sequential oracle
+  (karpenter_tpu.solver.oracle.Scheduler) — the same object the TpuScheduler
+  derived its encoding from, still pristine because encode_problem only
+  reads it. Callers therefore never see UnsupportedBySolver.
+
+The fallback taxonomy (what routes to the oracle) is documented in
+tpu_problem._check_pod_supported: preference relaxation, host ports, volume
+claims, hostname selectors, reserved capacity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.api.objects import NodePool, Pod
+from karpenter_tpu.cloudprovider.types import InstanceTypes
+from karpenter_tpu.solver.nodes import StateNodeView
+from karpenter_tpu.solver.oracle import Results, Scheduler, SchedulerOptions
+from karpenter_tpu.solver.topology import Topology
+from karpenter_tpu.solver.tpu import TpuScheduler
+from karpenter_tpu.solver.tpu_problem import UnsupportedBySolver
+
+
+class HybridScheduler:
+    """Same constructor and solve() surface as oracle.Scheduler.
+
+    After solve():
+    - ``used_tpu`` is True when the TPU path produced the result;
+    - ``fallback_reason`` holds the UnsupportedBySolver message when the
+      oracle ran instead (None on the TPU path).
+    """
+
+    def __init__(
+        self,
+        node_pools: list[NodePool],
+        instance_types_by_pool: dict[str, InstanceTypes],
+        topology: Topology,
+        state_nodes: Optional[list[StateNodeView]] = None,
+        daemonset_pods: Optional[list[Pod]] = None,
+        options: Optional[SchedulerOptions] = None,
+        force_oracle: bool = False,
+    ):
+        self.force_oracle = force_oracle
+        self.used_tpu: Optional[bool] = None
+        self.fallback_reason: Optional[str] = None
+        if force_oracle:
+            self.tpu: Optional[TpuScheduler] = None
+            self.oracle = Scheduler(
+                node_pools,
+                instance_types_by_pool,
+                topology,
+                state_nodes,
+                daemonset_pods,
+                options,
+            )
+        else:
+            self.tpu = TpuScheduler(
+                node_pools,
+                instance_types_by_pool,
+                topology,
+                state_nodes,
+                daemonset_pods,
+                options,
+            )
+            self.oracle = self.tpu.oracle
+        self.opts = self.oracle.opts
+
+    def solve(self, pods: list[Pod]) -> Results:
+        """Never raises UnsupportedBySolver."""
+        if self.tpu is not None:
+            try:
+                results = self.tpu.solve(pods)
+                self.used_tpu = True
+                self.fallback_reason = None
+                return results
+            except UnsupportedBySolver as e:
+                # encode_problem raises before mutating the oracle or the
+                # shared Topology, so the oracle can run on the same state
+                self.fallback_reason = str(e)
+        self.used_tpu = False
+        return self.oracle.solve(pods)

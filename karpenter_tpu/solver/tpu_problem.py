@@ -334,9 +334,12 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
     # ---- existing nodes ------------------------------------------------
     E = len(scheduler.existing_nodes)
     p.num_existing = E
-    p.ereq = encode_requirements(
-        vocab, [n.requirements for n in scheduler.existing_nodes]
-    )
+    try:
+        p.ereq = encode_requirements(
+            vocab, [n.requirements for n in scheduler.existing_nodes]
+        )
+    except UnsupportedProblem as e:
+        raise UnsupportedBySolver(str(e)) from e
     try:
         p.eavail = (
             np.stack(
@@ -458,11 +461,14 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
                 )
             p.h_seed.append((g, slot, c))
 
-    p.filter_reqs = (
-        encode_requirements(vocab, filter_sets)
-        if filter_sets
-        else empty_reqs(vocab, (0,))
-    )
+    try:
+        p.filter_reqs = (
+            encode_requirements(vocab, filter_sets)
+            if filter_sets
+            else empty_reqs(vocab, (0,))
+        )
+    except UnsupportedProblem as e:
+        raise UnsupportedBySolver(str(e)) from e
 
     # ---- pods ----------------------------------------------------------
     _encode_pods(p, pods, group_vid)

@@ -1,0 +1,95 @@
+"""HybridScheduler dispatch: TPU path for supported problems, transparent
+oracle fallback on UnsupportedBySolver — callers never see the exception
+(reference contract: Scheduler.Solve never fails on feature grounds,
+scheduler.go:377)."""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.solver import HybridScheduler, Scheduler, Topology
+from karpenter_tpu.solver.tpu_problem import UnsupportedBySolver
+from karpenter_tpu.testing import fixtures
+
+
+def _universe():
+    return construct_instance_types(sizes=[2, 8, 32])
+
+
+def _problem(pods):
+    its = _universe()
+    np_ = fixtures.node_pool(name="default")
+    topo = Topology([np_], {"default": its}, pods)
+    return [np_], {"default": its}, topo
+
+
+def test_supported_problem_uses_tpu_and_matches_oracle():
+    fixtures.reset_rng(7)
+    pods = fixtures.make_diverse_pods(20)
+    h = HybridScheduler(*_problem(pods))
+    results = h.solve(pods)
+    assert h.used_tpu is True
+    assert h.fallback_reason is None
+
+    fixtures.reset_rng(7)
+    pods2 = fixtures.make_diverse_pods(20)
+    oracle = Scheduler(*_problem(pods2))
+    want = oracle.solve(pods2)
+    # claim lists differ in order (the oracle re-sorts by pod count during
+    # solve); the packing itself must match as a multiset
+    assert sorted(results.node_pod_counts()) == sorted(want.node_pod_counts())
+    assert set(results.pod_errors) == set(want.pod_errors)
+
+
+def test_unsupported_problem_falls_back_without_raising():
+    fixtures.reset_rng(7)
+    # preferred node affinity is on the relaxation ladder -> unsupported by
+    # the tensor encoding (tpu_problem._check_pod_supported)
+    pods = fixtures.make_preference_pods(8)
+    h = HybridScheduler(*_problem(pods))
+    results = h.solve(pods)  # must not raise
+    assert h.used_tpu is False
+    assert h.fallback_reason is not None
+    assert "relaxable" in h.fallback_reason
+    assert not results.pod_errors
+
+    # and the fallback result equals a pure-oracle run of the same problem
+    fixtures.reset_rng(7)
+    pods2 = fixtures.make_preference_pods(8)
+    want = Scheduler(*_problem(pods2)).solve(pods2)
+    assert results.node_pod_counts() == want.node_pod_counts()
+
+
+def test_tpu_path_raises_only_inside_dispatch():
+    """Direct TpuScheduler use still raises (bench harness relies on it);
+    the hybrid wrapper is what absorbs it."""
+    from karpenter_tpu.solver.tpu import TpuScheduler
+
+    fixtures.reset_rng(7)
+    pods = fixtures.make_preference_pods(4)
+    t = TpuScheduler(*_problem(pods))
+    with pytest.raises(UnsupportedBySolver):
+        t.solve(pods)
+
+
+def test_force_oracle():
+    fixtures.reset_rng(7)
+    pods = fixtures.make_diverse_pods(10)
+    h = HybridScheduler(*_problem(pods), force_oracle=True)
+    results = h.solve(pods)
+    assert h.used_tpu is False
+    assert h.tpu is None
+    assert sum(results.node_pod_counts()) + len(results.pod_errors) == len(pods)
+
+
+def test_host_ports_fall_back():
+    fixtures.reset_rng(7)
+    pods = fixtures.make_generic_pods(4)
+    pods[2].host_ports = [("", "TCP", 8080)]
+    h = HybridScheduler(*_problem(pods))
+    results = h.solve(pods)
+    assert h.used_tpu is False
+    assert "host ports" in h.fallback_reason
+    assert not results.pod_errors
