@@ -365,6 +365,52 @@ class NodeClaim:
         return self.metadata.labels.get(l.NODEPOOL_LABEL_KEY)
 
 
+# ---------------------------------------------------------------------------
+# PodDisruptionBudget (the slice eviction/disruption needs)
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: "LabelSelector" = field(default_factory=lambda: LabelSelector())
+    # exactly one of these is set; values are "<int>" or "<int>%"
+    min_available: Optional[str] = None
+    max_unavailable: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+# ---------------------------------------------------------------------------
+# Storage (the slice volume topology needs; reference volumetopology.go:43)
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    # zones from allowedTopologies (empty = no restriction)
+    zones: list[str] = field(default_factory=list)
+    volume_binding_mode: str = "WaitForFirstConsumer"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class_name: str = ""
+    volume_name: str = ""  # bound PV (empty while unbound)
+    # the zone of the bound volume's node affinity (empty while unbound)
+    volume_zones: list[str] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
 # Status condition types used across controllers (reference apis/v1/*_status.go)
 COND_LAUNCHED = "Launched"
 COND_REGISTERED = "Registered"

@@ -130,3 +130,197 @@ def construct_instance_types(
             )
         )
     return out
+
+
+# ---------------------------------------------------------------------------
+# The KWOK cloud provider: fabricates Node objects directly (no kubelet, no
+# cloud API), with an async registration delay — reference
+# kwok/cloudprovider/cloudprovider.go:58-86 (Create), :185-236 (toNode).
+
+
+class KwokCloudProvider:
+    """CloudProvider whose instances are simulated Nodes in the API store.
+
+    Create() records the instance immediately and queues the Node object to
+    appear after `registration_delay` seconds (the reference launches a
+    goroutine sleeping NodeRegistrationDelay; with a step clock the queue is
+    flushed by reconcile(), which the operator loop and tests drive)."""
+
+    def __init__(
+        self,
+        kube,
+        clock,
+        instance_types=None,
+        registration_delay_seconds: float = 2.0,
+    ):
+        from karpenter_tpu.cloudprovider.types import CloudProvider  # noqa: F401
+
+        self.kube = kube
+        self.clock = clock
+        self.types = (
+            instance_types if instance_types is not None else construct_instance_types()
+        )
+        self._by_name = {it.name: it for it in self.types}
+        self.registration_delay = registration_delay_seconds
+        self.instances: dict[str, object] = {}  # provider id -> NodeClaim view
+        self._pending_nodes: list[tuple[float, object]] = []
+        self.next_create_error: Optional[Exception] = None
+        self.created: list[object] = []
+        self.deleted: list[str] = []
+
+    # -- SPI --------------------------------------------------------------
+
+    def create(self, node_claim):
+        """Pick the cheapest compatible offering and fabricate the node
+        (kwok cloudprovider.go:58,198)."""
+        import copy as copy_mod
+
+        from karpenter_tpu.api import labels as wk
+        from karpenter_tpu.api.objects import Node, ObjectMeta, Taint
+        from karpenter_tpu.cloudprovider.types import CreateError
+        from karpenter_tpu.scheduling import Requirements as Reqs_
+
+        if self.next_create_error is not None:
+            err, self.next_create_error = self.next_create_error, None
+            raise err
+
+        from karpenter_tpu.scheduling import ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+
+        reqs = Reqs_.from_node_selector_requirements(node_claim.requirements)
+        best = None  # (price, it, offering)
+        for it in self.types:
+            if not reqs.is_compatible(
+                it.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+            ):
+                continue
+            for o in it.offerings:
+                if not o.available:
+                    continue
+                if not reqs.is_compatible(
+                    o.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                ):
+                    continue
+                if best is None or o.price < best[0]:
+                    best = (o.price, it, o)
+        if best is None:
+            raise CreateError(
+                "no instance type offering satisfies the claim requirements",
+                reason="NoCompatibleOffering",
+            )
+        _, it, offering = best
+
+        claim = copy_mod.deepcopy(node_claim)
+        provider_id = f"kwok://{claim.name}"
+        claim.status.provider_id = provider_id
+        claim.status.node_name = claim.name
+        claim.status.capacity = dict(it.capacity)
+        claim.status.allocatable = dict(it.allocatable())
+        claim.status.image_id = "kwok-image"
+        self.instances[provider_id] = claim
+        self.created.append(claim)
+
+        labels = dict(claim.metadata.labels)
+        for r in claim.requirements:
+            if r.operator == Operator.IN and len(r.values) == 1:
+                labels.setdefault(r.key, r.values[0])
+        for r in it.requirements.values():
+            vals = r.values
+            if not r.complement and len(vals) == 1:
+                labels[r.key] = next(iter(vals))
+        labels[wk.INSTANCE_TYPE_LABEL_KEY] = it.name
+        labels[wk.TOPOLOGY_ZONE_LABEL_KEY] = offering.zone()
+        labels[wk.CAPACITY_TYPE_LABEL_KEY] = offering.capacity_type()
+        labels[wk.HOSTNAME_LABEL_KEY] = claim.name
+        labels[PARTITION_LABEL_KEY] = offering.zone()
+
+        node = Node(
+            metadata=ObjectMeta(
+                name=claim.name,
+                labels=labels,
+                finalizers=[wk.TERMINATION_FINALIZER],
+                owner_uid=claim.metadata.uid,
+            ),
+            provider_id=provider_id,
+            capacity=dict(it.capacity),
+            allocatable=dict(it.allocatable()),
+            taints=list(claim.taints)
+            + list(claim.startup_taints)
+            + [Taint(key="karpenter.sh/unregistered", effect="NoExecute")],
+            ready=True,
+        )
+        self._pending_nodes.append(
+            (self.clock.now() + self.registration_delay, node)
+        )
+        return claim
+
+    def reconcile(self) -> int:
+        """Flush nodes whose registration delay elapsed into the store.
+        Returns how many joined."""
+        from karpenter_tpu.controllers.kube import AlreadyExists
+
+        now = self.clock.now()
+        due = [n for t, n in self._pending_nodes if t <= now]
+        self._pending_nodes = [(t, n) for t, n in self._pending_nodes if t > now]
+        joined = 0
+        for node in due:
+            if node.provider_id not in self.instances:
+                continue  # deleted before it registered
+            try:
+                self.kube.create("Node", node)
+                joined += 1
+            except AlreadyExists:
+                pass
+        return joined
+
+    def delete(self, node_claim) -> None:
+        from karpenter_tpu.cloudprovider.types import NodeClaimNotFoundError
+        from karpenter_tpu.controllers.kube import NotFound
+
+        pid = node_claim.status.provider_id or f"kwok://{node_claim.name}"
+        if pid not in self.instances:
+            raise NodeClaimNotFoundError(pid)
+        del self.instances[pid]
+        self.deleted.append(pid)
+
+    def get(self, provider_id: str):
+        from karpenter_tpu.cloudprovider.types import NodeClaimNotFoundError
+
+        claim = self.instances.get(provider_id)
+        if claim is None:
+            raise NodeClaimNotFoundError(provider_id)
+        return claim
+
+    def list(self):
+        return list(self.instances.values())
+
+    def get_instance_types(self, node_pool):
+        return self.types
+
+    def get_instance_types_by_name(self, node_claim):
+        from karpenter_tpu.cloudprovider.types import InstanceTypes as ITs
+
+        return ITs(
+            it
+            for r in node_claim.requirements
+            if r.key == well_known.INSTANCE_TYPE_LABEL_KEY
+            for name in r.values
+            for it in [self._by_name.get(name)]
+            if it is not None
+        )
+
+    def is_drifted(self, node_claim) -> str:
+        return ""  # hash-based drift is detected by the drift controller
+
+    def repair_policies(self):
+        from karpenter_tpu.cloudprovider.types import RepairPolicy
+
+        return [
+            RepairPolicy(
+                condition_type="Ready",
+                condition_status="False",
+                toleration_seconds=120.0,
+            )
+        ]
+
+    def name(self) -> str:
+        return "kwok"

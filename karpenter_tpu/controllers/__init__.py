@@ -11,5 +11,24 @@ receives problems through the HybridScheduler dispatch.
 """
 
 from karpenter_tpu.controllers.kube import Conflict, FakeClock, RealClock, SimKube
+from karpenter_tpu.controllers.lifecycle import NodeClaimLifecycle
+from karpenter_tpu.controllers.operator import Operator
+from karpenter_tpu.controllers.provisioning import Batcher, Provisioner, VolumeTopology
+from karpenter_tpu.controllers.state import Cluster, StateNode, wire_informers
+from karpenter_tpu.controllers.termination import NodeTermination
 
-__all__ = ["SimKube", "Conflict", "FakeClock", "RealClock"]
+__all__ = [
+    "Batcher",
+    "Cluster",
+    "Conflict",
+    "FakeClock",
+    "NodeClaimLifecycle",
+    "NodeTermination",
+    "Operator",
+    "Provisioner",
+    "RealClock",
+    "SimKube",
+    "StateNode",
+    "VolumeTopology",
+    "wire_informers",
+]

@@ -1,0 +1,230 @@
+"""NodeClaim lifecycle: Launch -> Registration -> Initialization + Liveness,
+plus the claim termination finalizer.
+
+Reference /root/reference/pkg/controllers/nodeclaim/lifecycle/:
+- launch.go:45-124 (CloudProvider.Create, Launched condition)
+- registration.go:50-127 (node joins; sync labels/taints; Registered)
+- initialization.go:46-134 (startup taints gone, resources present; Initialized)
+- liveness.go:51-75 (TTL deletes for stuck claims)
+- controller.go:184-273 (termination finalizer: delete instance + node)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import (
+    COND_INITIALIZED,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+    NodeClaim,
+)
+from karpenter_tpu.cloudprovider.types import CreateError, NodeClaimNotFoundError
+from karpenter_tpu.controllers.kube import Conflict, NotFound, SimKube
+from karpenter_tpu.controllers.state import UNREGISTERED_TAINT, Cluster
+from karpenter_tpu.events import Event, Recorder
+from karpenter_tpu.options import Options
+from karpenter_tpu import metrics
+
+TERMINATION_FINALIZER = well_known.TERMINATION_FINALIZER
+
+LAUNCH_FAILURES = metrics.REGISTRY.counter(
+    "karpenter_nodeclaims_launch_failed_total",
+    "NodeClaim launch attempts that failed.",
+    ("nodepool", "reason"),
+)
+CLAIMS_TERMINATED = metrics.REGISTRY.counter(
+    "karpenter_nodeclaims_terminated_total",
+    "NodeClaims terminated.",
+    ("nodepool",),
+)
+
+
+class NodeClaimLifecycle:
+    """One reconciler driving the whole claim state machine (the reference
+    splits it into sub-reconcilers invoked in order; the order here is the
+    same)."""
+
+    def __init__(
+        self,
+        kube: SimKube,
+        cluster: Cluster,
+        cloud_provider,
+        clock,
+        options: Optional[Options] = None,
+        recorder: Optional[Recorder] = None,
+    ):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud = cloud_provider
+        self.clock = clock
+        self.opts = options or Options()
+        self.recorder = recorder or Recorder(clock)
+        # claim name -> first-seen time, for liveness TTLs with FakeClock
+        self._first_seen: dict[str, float] = {}
+
+    def reconcile_all(self) -> None:
+        for claim in self.kube.list("NodeClaim"):
+            self.reconcile(claim.name)
+
+    def reconcile(self, name: str) -> Optional[str]:
+        claim = self.kube.try_get("NodeClaim", name)
+        if claim is None:
+            self._first_seen.pop(name, None)
+            return None
+        if claim.metadata.deletion_timestamp is not None:
+            return self._terminate(claim)
+        if TERMINATION_FINALIZER not in claim.metadata.finalizers:
+            claim.metadata.finalizers.append(TERMINATION_FINALIZER)
+            claim = self._update(claim)
+            if claim is None:
+                return None
+        self._first_seen.setdefault(name, self.clock.now())
+
+        if claim.status.conditions.get(COND_LAUNCHED) != "True":
+            return self._launch(claim)
+        if claim.status.conditions.get(COND_REGISTERED) != "True":
+            return self._register(claim)
+        if claim.status.conditions.get(COND_INITIALIZED) != "True":
+            return self._initialize(claim)
+        return None
+
+    # -- phases -----------------------------------------------------------
+
+    def _launch(self, claim: NodeClaim) -> Optional[str]:
+        try:
+            launched = self.cloud.create(claim)
+        except CreateError as e:
+            nodepool = claim.nodepool_name or ""
+            LAUNCH_FAILURES.inc({"nodepool": nodepool, "reason": e.reason})
+            self.recorder.publish(
+                Event("NodeClaim", claim.name, "Warning", "LaunchFailed", str(e))
+            )
+            return self._liveness(claim)
+        claim.status.provider_id = launched.status.provider_id
+        claim.status.node_name = launched.status.node_name
+        claim.status.capacity = dict(launched.status.capacity)
+        claim.status.allocatable = dict(launched.status.allocatable)
+        claim.status.image_id = launched.status.image_id
+        claim.status.conditions[COND_LAUNCHED] = "True"
+        self._update(claim)
+        return "launched"
+
+    def _register(self, claim: NodeClaim) -> Optional[str]:
+        node = self._node_for(claim)
+        if node is None:
+            return self._liveness(claim)
+        # sync: claim labels/annotations flow to the node; the unregistered
+        # taint is removed exactly once (registration.go:50-127)
+        changed = False
+        for k, v in claim.metadata.labels.items():
+            if node.metadata.labels.get(k) != v:
+                node.metadata.labels[k] = v
+                changed = True
+        if UNREGISTERED_TAINT in node.taints:
+            node.taints = [t for t in node.taints if t != UNREGISTERED_TAINT]
+            changed = True
+        if node.metadata.labels.get(well_known.NODE_REGISTERED_LABEL_KEY) != "true":
+            node.metadata.labels[well_known.NODE_REGISTERED_LABEL_KEY] = "true"
+            changed = True
+        if changed:
+            try:
+                self.kube.update("Node", node)
+            except (Conflict, NotFound):
+                return None  # requeue
+        claim.status.node_name = node.name
+        claim.status.conditions[COND_REGISTERED] = "True"
+        self._update(claim)
+        self.recorder.publish(
+            Event("NodeClaim", claim.name, "Normal", "Registered", node.name)
+        )
+        return "registered"
+
+    def _initialize(self, claim: NodeClaim) -> Optional[str]:
+        node = self._node_for(claim)
+        if node is None:
+            return None
+        if not node.ready:
+            return None
+        # startup taints must have been removed (initialization.go:46)
+        startup = set(claim.startup_taints)
+        if any(t in startup for t in node.taints):
+            return None
+        # resources registered
+        if not node.allocatable:
+            return None
+        node.metadata.labels[well_known.NODE_INITIALIZED_LABEL_KEY] = "true"
+        try:
+            self.kube.update("Node", node)
+        except (Conflict, NotFound):
+            return None
+        claim.status.conditions[COND_INITIALIZED] = "True"
+        self._update(claim)
+        return "initialized"
+
+    def _liveness(self, claim: NodeClaim) -> Optional[str]:
+        """liveness.go:51: delete claims stuck before registration."""
+        first = self._first_seen.get(claim.name, self.clock.now())
+        age = self.clock.now() - first
+        launched = claim.status.conditions.get(COND_LAUNCHED) == "True"
+        if not launched and age > self.opts.launch_ttl_seconds:
+            self.kube.delete("NodeClaim", claim.name)
+            self.recorder.publish(
+                Event(
+                    "NodeClaim", claim.name, "Warning", "LivenessTimeout",
+                    f"not launched after {age:.0f}s",
+                )
+            )
+            return "liveness-deleted"
+        if launched and age > self.opts.registration_ttl_seconds:
+            self.kube.delete("NodeClaim", claim.name)
+            self.recorder.publish(
+                Event(
+                    "NodeClaim", claim.name, "Warning", "LivenessTimeout",
+                    f"not registered after {age:.0f}s",
+                )
+            )
+            return "liveness-deleted"
+        return None
+
+    # -- termination finalizer (controller.go:184) ------------------------
+
+    def _terminate(self, claim: NodeClaim) -> Optional[str]:
+        # delete the node first; its own termination finalizer drains it
+        node = self._node_for(claim)
+        if node is not None and node.metadata.deletion_timestamp is None:
+            self.kube.delete("Node", node.name)
+            return "awaiting-node-termination"
+        if node is not None:
+            return "awaiting-node-termination"
+        try:
+            self.cloud.delete(claim)
+        except NodeClaimNotFoundError:
+            pass
+        if TERMINATION_FINALIZER in claim.metadata.finalizers:
+            claim.metadata.finalizers.remove(TERMINATION_FINALIZER)
+            try:
+                self.kube.update("NodeClaim", claim)
+            except (Conflict, NotFound):
+                return None
+        CLAIMS_TERMINATED.inc({"nodepool": claim.nodepool_name or ""})
+        self._first_seen.pop(claim.name, None)
+        return "terminated"
+
+    # -- helpers ----------------------------------------------------------
+
+    def _node_for(self, claim: NodeClaim):
+        if claim.status.provider_id:
+            for node in self.kube.list("Node"):
+                if node.provider_id == claim.status.provider_id:
+                    return node
+        if claim.status.node_name:
+            return self.kube.try_get("Node", claim.status.node_name)
+        return None
+
+    def _update(self, claim: NodeClaim) -> Optional[NodeClaim]:
+        try:
+            return self.kube.update("NodeClaim", claim)
+        except (Conflict, NotFound):
+            return None

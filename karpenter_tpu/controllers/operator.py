@@ -1,0 +1,113 @@
+"""The operator runtime: assembles the store, state cache, provider, and
+controllers, and drives them as one simulation-friendly loop.
+
+Reference /root/reference/pkg/operator/operator.go:117-249 + kwok/main.go:29-51.
+The reference runs controllers on a manager with watches and leader election;
+here the same controllers are driven by an explicit `step()` tick, which is
+what the tests and the benchmark harness call (the reference's envtest suites
+drive reconcilers manually the same way — SURVEY.md §4.1)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.kube import FakeClock, SimKube
+from karpenter_tpu.controllers.lifecycle import NodeClaimLifecycle
+from karpenter_tpu.controllers.provisioning import Provisioner
+from karpenter_tpu.controllers.state import Cluster, is_provisionable, wire_informers
+from karpenter_tpu.controllers.termination import NodeTermination
+from karpenter_tpu.events import Recorder
+from karpenter_tpu.options import Options
+
+
+class Operator:
+    """NewOperator + WithControllers + Start, in simulation time."""
+
+    def __init__(
+        self,
+        clock=None,
+        cloud_provider=None,
+        options: Optional[Options] = None,
+        force_oracle: bool = False,
+    ):
+        self.clock = clock or FakeClock()
+        self.opts = options or Options()
+        self.kube = SimKube(self.clock)
+        self.cluster = Cluster(self.clock)
+        wire_informers(self.kube, self.cluster)
+        self.recorder = Recorder(self.clock)
+        self.cloud = cloud_provider or KwokCloudProvider(self.kube, self.clock)
+        self.provisioner = Provisioner(
+            self.kube,
+            self.cluster,
+            self.cloud,
+            self.clock,
+            self.opts,
+            self.recorder,
+            force_oracle=force_oracle,
+        )
+        self.lifecycle = NodeClaimLifecycle(
+            self.kube, self.cluster, self.cloud, self.clock, self.opts, self.recorder
+        )
+        self.termination = NodeTermination(
+            self.kube, self.cluster, self.cloud, self.clock, self.recorder
+        )
+        self.disruption = None  # attached by karpenter_tpu.controllers.disruption
+
+        # trigger controllers (provisioning/controller.go:44): watch events
+        def triggers(event: str, kind: str, obj) -> None:
+            if kind == "Pod" and event in ("added", "updated"):
+                if isinstance(obj, Pod) and is_provisionable(obj):
+                    self.provisioner.trigger_pod(obj)
+            if kind == "Node" and event == "updated":
+                if obj.metadata.deletion_timestamp is not None:
+                    self.provisioner.trigger_node_deletion(obj.name)
+
+        self.kube.subscribe(triggers)
+
+    # -- loop -------------------------------------------------------------
+
+    def step(self, advance_seconds: float = 1.0) -> None:
+        """One control-plane tick: advance time, flush provider async work,
+        run every controller once (informer updates flow synchronously via
+        the store subscription)."""
+        if isinstance(self.clock, FakeClock):
+            self.clock.advance(advance_seconds)
+        if hasattr(self.cloud, "reconcile"):
+            self.cloud.reconcile()  # KWOK registration delays
+        self.lifecycle.reconcile_all()
+        self.termination.reconcile_all()
+        # the pod trigger controller requeues provisionable pods continuously
+        # (provisioning/controller.go:60); without it a pod that failed or
+        # awaits a node would never reopen the batch window
+        for pod in self.kube.pending_pods():
+            self.provisioner.trigger_pod(pod)
+        self.provisioner.reconcile()
+        if self.disruption is not None:
+            self.disruption.reconcile()
+
+    def run_until_settled(self, max_ticks: int = 60, advance_seconds: float = 2.0) -> int:
+        """Step until no pending pods remain and all claims are initialized
+        (or the tick budget runs out). Returns ticks used."""
+        for tick in range(1, max_ticks + 1):
+            self.step(advance_seconds)
+            if self.settled():
+                return tick
+        return max_ticks
+
+    def settled(self) -> bool:
+        from karpenter_tpu.api.objects import COND_INITIALIZED
+
+        if self.kube.pending_pods():
+            return False
+        for claim in self.kube.list("NodeClaim"):
+            if claim.metadata.deletion_timestamp is not None:
+                return False
+            if claim.status.conditions.get(COND_INITIALIZED) != "True":
+                return False
+        for node in self.kube.list("Node"):
+            if node.metadata.deletion_timestamp is not None:
+                return False
+        return True

@@ -1,0 +1,202 @@
+"""Node termination: the finalizer-driven drain.
+
+Reference /root/reference/pkg/controllers/node/termination/:
+- controller.go:91-289 (taint -> drain -> volume detach -> instance delete)
+- terminator/terminator.go:96-176 (priority-grouped eviction, grace periods)
+- terminator/eviction.go:93-230 (PDB-aware eviction queue)
+
+Flow per reconcile of a deleting Node:
+1. ensure the disrupted NoSchedule taint,
+2. evict evictable pods in priority groups (PDB-gated), daemonsets last,
+3. once drained, delete the cloud instance and drop the finalizer
+   (the Node object then vanishes; the claim's finalizer completes next).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import Node, Pod, PodPhase
+from karpenter_tpu.cloudprovider.types import NodeClaimNotFoundError
+from karpenter_tpu.controllers.kube import Conflict, NotFound, SimKube
+from karpenter_tpu.controllers.state import DISRUPTED_TAINT, Cluster
+from karpenter_tpu.events import Event, Recorder
+from karpenter_tpu import metrics
+
+NODES_DRAINED = metrics.REGISTRY.counter(
+    "karpenter_nodes_drained_total", "Nodes fully drained by termination.", ("nodepool",)
+)
+PODS_EVICTED = metrics.REGISTRY.counter(
+    "karpenter_nodes_evicted_pods_total", "Pods evicted during node drain."
+)
+
+
+def is_evictable(pod: Pod) -> bool:
+    """terminator.go:96 groupPodsByPriority candidates: running/pending pods
+    that aren't already terminal or terminating."""
+    return (
+        pod.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+        and pod.metadata.deletion_timestamp is None
+        and not pod.terminating
+    )
+
+
+def is_daemonset(pod: Pod) -> bool:
+    return bool(pod.metadata.annotations.get("karpenter.sh/daemonset"))
+
+
+class NodeTermination:
+    def __init__(
+        self,
+        kube: SimKube,
+        cluster: Cluster,
+        cloud_provider,
+        clock,
+        recorder: Optional[Recorder] = None,
+        eviction_grace_seconds: float = 0.0,
+    ):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud = cloud_provider
+        self.clock = clock
+        self.recorder = recorder or Recorder(clock)
+        self.grace = eviction_grace_seconds
+
+    def reconcile_all(self) -> None:
+        for node in self.kube.list("Node"):
+            if node.metadata.deletion_timestamp is not None:
+                self.reconcile(node.name)
+
+    def reconcile(self, name: str) -> Optional[str]:
+        node = self.kube.try_get("Node", name)
+        if node is None:
+            return None
+        if node.metadata.deletion_timestamp is None:
+            return None
+        if well_known.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            return None
+
+        # 1. taint (terminator.go Taint; statenode.go:483 RequireNoScheduleTaint)
+        if DISRUPTED_TAINT not in node.taints:
+            node.taints = list(node.taints) + [DISRUPTED_TAINT]
+            try:
+                node = self.kube.update("Node", node)
+            except (Conflict, NotFound):
+                return None
+            if node is None:
+                return None
+
+        # enforce terminationGracePeriod on the claim if set
+        claim = self._claim_for(node)
+        force = False
+        if (
+            claim is not None
+            and claim.termination_grace_period_seconds is not None
+            and node.metadata.deletion_timestamp is not None
+        ):
+            force = (
+                self.clock.now() - node.metadata.deletion_timestamp
+                > claim.termination_grace_period_seconds
+            )
+
+        # 2. drain: evict in ascending priority groups, workload pods before
+        # daemonset pods (terminator.go:96 groupPodsByPriority)
+        pods = [p for p in self.kube.list("Pod") if p.node_name == name]
+        workload = [p for p in pods if is_evictable(p) and not is_daemonset(p)]
+        if workload:
+            lowest = min(p.priority for p in workload)
+            group = [p for p in workload if p.priority == lowest]
+            evicted = self._evict(group, force)
+            if evicted:
+                return "draining"
+            if not force:
+                return "drain-blocked"
+        daemons = [p for p in pods if is_evictable(p) and is_daemonset(p)]
+        if daemons:
+            if self._evict(daemons, force):
+                return "draining"
+            if not force:
+                return "drain-blocked"
+        # terminating pods still exiting?
+        if any(
+            p.terminating or p.metadata.deletion_timestamp is not None
+            for p in pods
+        ):
+            self._finish_evictions(name)
+            if any(p.node_name == name for p in self.kube.list("Pod")):
+                return "awaiting-pod-exit"
+
+        nodepool = node.metadata.labels.get(well_known.NODEPOOL_LABEL_KEY, "")
+        NODES_DRAINED.inc({"nodepool": nodepool})
+
+        # 3. instance deletion + finalizer removal (controller.go:269)
+        if claim is not None:
+            try:
+                self.cloud.delete(claim)
+            except NodeClaimNotFoundError:
+                pass
+        node = self.kube.try_get("Node", name)
+        if node is None:
+            return "terminated"
+        node.metadata.finalizers = [
+            f for f in node.metadata.finalizers if f != well_known.TERMINATION_FINALIZER
+        ]
+        try:
+            self.kube.update("Node", node)
+        except (Conflict, NotFound):
+            return None
+        self.recorder.publish(
+            Event("Node", name, "Normal", "Terminated", "node drained and removed")
+        )
+        return "terminated"
+
+    # -- eviction ---------------------------------------------------------
+
+    def _evict(self, pods: list[Pod], force: bool) -> int:
+        """PDB-aware evictions (eviction.go:93). Returns how many started."""
+        from karpenter_tpu.utils.pdb import PDBLimits
+
+        limits = PDBLimits.from_kube(self.kube)
+        count = 0
+        for pod in pods:
+            if not force:
+                blocked = limits.is_fully_blocked(pod)
+                ok, reason = limits.can_evict(pod)
+                if blocked is not None or not ok:
+                    self.recorder.publish(
+                        Event(
+                            "Pod", pod.name, "Warning", "EvictionBlocked",
+                            blocked or reason or "",
+                        )
+                    )
+                    continue
+                limits.record_eviction(pod)
+            pod.terminating = True
+            try:
+                self.kube.update("Pod", pod)
+            except (Conflict, NotFound):
+                continue
+            PODS_EVICTED.inc()
+            count += 1
+        return count
+
+    def _finish_evictions(self, node_name: str) -> None:
+        """Terminating pods exit after their grace period (the kubelet's
+        role, simulated)."""
+        for pod in self.kube.list("Pod"):
+            if pod.node_name != node_name or not pod.terminating:
+                continue
+            try:
+                self.kube.delete("Pod", pod.name)
+            except NotFound:
+                pass
+
+    def _claim_for(self, node: Node):
+        for claim in self.kube.list("NodeClaim"):
+            if (
+                claim.status.provider_id
+                and claim.status.provider_id == node.provider_id
+            ):
+                return claim
+        return None

@@ -1,0 +1,186 @@
+"""Prometheus-style metrics registry (reference /root/reference/pkg/metrics/
+metrics.go:32-99, constants.go:42-67, store.go:33-110).
+
+Namespace `karpenter`, counters/gauges/histograms keyed by label tuples, a
+`measure()` context manager mirroring the reference's defer-timer, and a
+keyed gauge Store for metric garbage collection (a gauge family whose stale
+series vanish when the backing object does). Exposition via render()."""
+
+from __future__ import annotations
+
+import math
+import time
+from contextlib import contextmanager
+from typing import Iterable, Optional
+
+NAMESPACE = "karpenter"
+
+# reference pkg/metrics/constants.go:42 DurationBuckets
+DURATION_BUCKETS = [
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300,
+]
+
+
+class Metric:
+    def __init__(self, name: str, help: str, label_names: tuple[str, ...]):
+        self.name = name
+        self.help = help
+        self.label_names = label_names
+
+    def _key(self, labels: dict[str, str]) -> tuple:
+        return tuple(labels.get(k, "") for k in self.label_names)
+
+
+class Counter(Metric):
+    def __init__(self, name, help, label_names=()):
+        super().__init__(name, help, tuple(label_names))
+        self.values: dict[tuple, float] = {}
+
+    def inc(self, labels: Optional[dict] = None, by: float = 1.0) -> None:
+        k = self._key(labels or {})
+        self.values[k] = self.values.get(k, 0.0) + by
+
+    def value(self, labels: Optional[dict] = None) -> float:
+        return self.values.get(self._key(labels or {}), 0.0)
+
+
+class Gauge(Metric):
+    def __init__(self, name, help, label_names=()):
+        super().__init__(name, help, tuple(label_names))
+        self.values: dict[tuple, float] = {}
+
+    def set(self, value: float, labels: Optional[dict] = None) -> None:
+        self.values[self._key(labels or {})] = value
+
+    def add(self, by: float, labels: Optional[dict] = None) -> None:
+        k = self._key(labels or {})
+        self.values[k] = self.values.get(k, 0.0) + by
+
+    def value(self, labels: Optional[dict] = None) -> float:
+        return self.values.get(self._key(labels or {}), 0.0)
+
+    def delete(self, labels: dict) -> None:
+        self.values.pop(self._key(labels), None)
+
+
+class Histogram(Metric):
+    def __init__(self, name, help, label_names=(), buckets=None):
+        super().__init__(name, help, tuple(label_names))
+        self.buckets = list(buckets or DURATION_BUCKETS)
+        self.counts: dict[tuple, list[int]] = {}
+        self.sums: dict[tuple, float] = {}
+        self.totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, labels: Optional[dict] = None) -> None:
+        k = self._key(labels or {})
+        if k not in self.counts:
+            self.counts[k] = [0] * len(self.buckets)
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[k][i] += 1
+        self.sums[k] = self.sums.get(k, 0.0) + value
+        self.totals[k] = self.totals.get(k, 0) + 1
+
+    def count(self, labels: Optional[dict] = None) -> int:
+        return self.totals.get(self._key(labels or {}), 0)
+
+    def sum(self, labels: Optional[dict] = None) -> float:
+        return self.sums.get(self._key(labels or {}), 0.0)
+
+    @contextmanager
+    def measure(self, labels: Optional[dict] = None):
+        """metrics.Measure defer-timer (constants.go:63)."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.observe(time.monotonic() - t0, labels)
+
+
+class Store:
+    """Keyed gauge store for metric GC (reference store.go:33): update(key)
+    replaces the series owned by that key; delete(key) removes them."""
+
+    def __init__(self, gauge: Gauge):
+        self.gauge = gauge
+        self._owned: dict[str, list[dict]] = {}
+
+    def update(self, key: str, series: list[tuple[dict, float]]) -> None:
+        self.delete(key)
+        owned = []
+        for labels, value in series:
+            self.gauge.set(value, labels)
+            owned.append(labels)
+        self._owned[key] = owned
+
+    def delete(self, key: str) -> None:
+        for labels in self._owned.pop(key, []):
+            self.gauge.delete(labels)
+
+
+class Registry:
+    def __init__(self):
+        self.metrics: dict[str, Metric] = {}
+
+    def counter(self, name, help, label_names=()) -> Counter:
+        return self._register(Counter(name, help, label_names))
+
+    def gauge(self, name, help, label_names=()) -> Gauge:
+        return self._register(Gauge(name, help, label_names))
+
+    def histogram(self, name, help, label_names=(), buckets=None) -> Histogram:
+        return self._register(Histogram(name, help, label_names, buckets))
+
+    def _register(self, m):
+        existing = self.metrics.get(m.name)
+        if existing is not None:
+            return existing
+        self.metrics[m.name] = m
+        return m
+
+    def render(self) -> str:
+        """Prometheus text exposition."""
+        lines = []
+        for m in self.metrics.values():
+            lines.append(f"# HELP {m.name} {m.help}")
+            kind = (
+                "counter"
+                if isinstance(m, Counter)
+                else "histogram"
+                if isinstance(m, Histogram)
+                else "gauge"
+            )
+            lines.append(f"# TYPE {m.name} {kind}")
+
+            def fmt(key):
+                if not m.label_names:
+                    return ""
+                pairs = ",".join(
+                    f'{n}="{v}"' for n, v in zip(m.label_names, key)
+                )
+                return "{" + pairs + "}"
+
+            if isinstance(m, Histogram):
+                for k, counts in m.counts.items():
+                    base = [f'{n}="{v}"' for n, v in zip(m.label_names, k)]
+                    for b, c in zip(m.buckets, counts):
+                        pairs = ",".join(base + [f'le="{b}"'])
+                        lines.append(f"{m.name}_bucket{{{pairs}}} {c}")
+                    inf_pairs = ",".join(base + ['le="+Inf"'])
+                    lines.append(f"{m.name}_bucket{{{inf_pairs}}} {m.totals[k]}")
+                    lines.append(f"{m.name}_sum{fmt(k)} {m.sums[k]}")
+                    lines.append(f"{m.name}_count{fmt(k)} {m.totals[k]}")
+            else:
+                for k, v in m.values.items():
+                    lines.append(f"{m.name}{fmt(k)} {v}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self):
+        self.metrics.clear()
+
+
+REGISTRY = Registry()
+
+
+def reset() -> None:
+    REGISTRY.reset()

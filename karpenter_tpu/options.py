@@ -1,0 +1,98 @@
+"""Operator options: the flat flag/env/feature-gate config system
+(reference /root/reference/pkg/operator/options/options.go:67-216).
+
+One dataclass carries every knob; `from_env` applies KARPENTER_* environment
+fallbacks; feature gates parse from the same comma-separated string the
+reference uses. Controllers receive Options explicitly (the reference
+injects it through context.Context — explicit wiring is the Python idiom).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FeatureGates:
+    """options.go:110 FeatureGates string:
+    NodeRepair,ReservedCapacity,SpotToSpotConsolidation,NodeOverlay,StaticCapacity"""
+
+    node_repair: bool = False
+    reserved_capacity: bool = False
+    spot_to_spot_consolidation: bool = False
+    node_overlay: bool = False
+    static_capacity: bool = False
+
+    @classmethod
+    def parse(cls, gates: str) -> "FeatureGates":
+        out = cls()
+        mapping = {
+            "NodeRepair": "node_repair",
+            "ReservedCapacity": "reserved_capacity",
+            "SpotToSpotConsolidation": "spot_to_spot_consolidation",
+            "NodeOverlay": "node_overlay",
+            "StaticCapacity": "static_capacity",
+        }
+        for part in gates.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" in part:
+                name, val = part.split("=", 1)
+                enabled = val.strip().lower() == "true"
+            else:
+                name, enabled = part, True
+            attr = mapping.get(name.strip())
+            if attr is not None:
+                setattr(out, attr, enabled)
+        return out
+
+
+@dataclass
+class Options:
+    # batching (options.go:126-127)
+    batch_idle_duration_seconds: float = 1.0
+    batch_max_duration_seconds: float = 10.0
+    # scheduling
+    preference_policy: str = "Respect"  # Respect | Ignore
+    min_values_policy: str = "Strict"  # Strict | BestEffort
+    solve_timeout_seconds: float = 60.0  # provisioner.go:366
+    # disruption
+    disruption_poll_seconds: float = 10.0  # disruption/controller.go:69
+    multinode_consolidation_timeout_seconds: float = 60.0
+    # lifecycle liveness TTLs (lifecycle/liveness.go)
+    launch_ttl_seconds: float = 300.0
+    registration_ttl_seconds: float = 900.0
+    # client emulation
+    kube_client_qps: int = 200
+    kube_client_burst: int = 300
+    # observability
+    log_level: str = "info"
+    enable_profiling: bool = False
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+
+    @classmethod
+    def from_env(cls, env: dict | None = None) -> "Options":
+        env = dict(os.environ if env is None else env)
+        opts = cls()
+
+        def f(key: str, cast, attr: str) -> None:
+            raw = env.get(key)
+            if raw is not None:
+                try:
+                    setattr(opts, attr, cast(raw))
+                except ValueError:
+                    pass
+
+        f("KARPENTER_BATCH_IDLE_DURATION", float, "batch_idle_duration_seconds")
+        f("KARPENTER_BATCH_MAX_DURATION", float, "batch_max_duration_seconds")
+        f("KARPENTER_PREFERENCE_POLICY", str, "preference_policy")
+        f("KARPENTER_MIN_VALUES_POLICY", str, "min_values_policy")
+        f("KARPENTER_KUBE_CLIENT_QPS", int, "kube_client_qps")
+        f("KARPENTER_KUBE_CLIENT_BURST", int, "kube_client_burst")
+        f("KARPENTER_LOG_LEVEL", str, "log_level")
+        gates = env.get("KARPENTER_FEATURE_GATES")
+        if gates:
+            opts.feature_gates = FeatureGates.parse(gates)
+        return opts

@@ -40,8 +40,9 @@ class HostPortUsage:
     def add(self, pod: Pod, ports: Iterable[HostPort]) -> None:
         self._by_pod[pod.uid] = list(ports)
 
-    def remove(self, pod: Pod) -> None:
-        self._by_pod.pop(pod.uid, None)
+    def remove(self, pod) -> None:
+        uid = pod if isinstance(pod, str) else pod.uid
+        self._by_pod.pop(uid, None)
 
     def copy(self) -> "HostPortUsage":
         c = HostPortUsage()

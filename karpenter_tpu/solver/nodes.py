@@ -27,6 +27,7 @@ from karpenter_tpu.scheduling import (
     Taints,
 )
 from karpenter_tpu.scheduling.hostports import HostPortUsage, get_host_ports
+from karpenter_tpu.scheduling.volumeusage import VolumeUsage, volume_limit
 from karpenter_tpu.solver.topology import Topology
 from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.resources import ResourceList
@@ -483,6 +484,7 @@ class StateNodeView:
     initialized: bool = False
     hostname: str = ""
     host_port_usage: HostPortUsage = field(default_factory=HostPortUsage)
+    volume_usage: VolumeUsage = field(default_factory=VolumeUsage)
     # set by the scheduler when a pod is nominated to this node
     nominations: int = 0
 
@@ -515,6 +517,8 @@ class ExistingNode:
             Requirement(well_known.HOSTNAME_LABEL_KEY, Operator.IN, [view.hostname])
         )
         self.host_port_usage = view.host_port_usage.copy()
+        self.volume_usage = view.volume_usage.copy()
+        self.volume_limit = volume_limit(view.labels)
         topology.register(well_known.HOSTNAME_LABEL_KEY, view.hostname)
 
     @property
@@ -532,6 +536,9 @@ class ExistingNode:
         hp_err = self.host_port_usage.conflicts(pod, get_host_ports(pod))
         if hp_err is not None:
             return None, f"checking host port usage, {hp_err}"
+        vol_err = self.volume_usage.exceeds_limit(pod, self.volume_limit)
+        if vol_err is not None:
+            return None, f"checking volume usage, {vol_err}"
         if not res.fits(pod_data.requests, self.remaining_resources):
             return None, "exceeds node resources"
         compat_err = self.requirements.compatible(pod_data.requirements)
@@ -556,3 +563,4 @@ class ExistingNode:
         self.requirements = requirements
         self.topology.record(pod, self.cached_taints, requirements)
         self.host_port_usage.add(pod, get_host_ports(pod))
+        self.volume_usage.add(pod)

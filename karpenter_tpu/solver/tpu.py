@@ -52,6 +52,39 @@ from karpenter_tpu.utils import resources as res
 _claim_seq = itertools.count(1)
 
 
+def _typeok_chunk_impl(ireq, va, preq_chunk, iw: int):
+    """[B, IW] u32: pairwise pod-vs-type requirement intersection bits."""
+    import jax.numpy as jnp
+
+    from karpenter_tpu.ops.kernels import intersects_only
+
+    B = preq_chunk.mask.shape[0]
+    I = ireq.mask.shape[0]
+    a = Reqs(*(x[None, :] for x in ireq))  # [1, I, ...]
+    b = Reqs(*(x[:, None] for x in preq_chunk))  # [B, 1, ...]
+    ok = intersects_only(a, b, va)  # [B, I]
+    pad = jnp.zeros((B, iw * 32 - I), bool)
+    bits = jnp.concatenate([ok, pad], axis=-1).reshape(B, iw, 32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, None]
+    return jnp.sum(bits.astype(jnp.uint32) * weights, axis=-1, dtype=jnp.uint32)
+
+
+_typeok_chunk_cached = None
+
+
+def _typeok_chunk(ireq, va, preq_chunk, iw: int):
+    """Module-level jit cache (a per-call closure would recompile every
+    solve)."""
+    global _typeok_chunk_cached
+    if _typeok_chunk_cached is None:
+        import jax
+
+        _typeok_chunk_cached = jax.jit(
+            _typeok_chunk_impl, static_argnames=("iw",)
+        )
+    return _typeok_chunk_cached(ireq, va, preq_chunk, iw=iw)
+
+
 def _pow2(n: int, floor: int = 8) -> int:
     p = floor
     while p < n:
@@ -114,31 +147,67 @@ class TpuScheduler:
         from karpenter_tpu.solver import tpu_kernel as K
 
         tb = self._tables(problem)
-        N = _pow2(len(pods))  # claim slots; pow2 so shape buckets are reused
-        st = self._init_state(problem, N)
+        self._typeok = self._pod_typeok(problem, tb)
 
-        kinds = np.full(len(pods), K.KIND_FAIL, dtype=np.int32)
-        slots = np.full(len(pods), -1, dtype=np.int32)
-        pending = list(order)
-        timed_out = False
-        while pending:
-            if deadline is not None and time_mod.monotonic() > deadline:
-                timed_out = True
+        # Claim slots: most solves create far fewer claims than pods (the
+        # bench mix averages ~5 pods/claim), so start small and grow on the
+        # kernel's overflow signal — smaller N cuts every per-step candidate
+        # screen. Worst case (one pod per claim) ends at _pow2(len(pods)).
+        N = min(_pow2(max(64, (len(pods) + 3) // 4)), _pow2(len(pods)))
+        while True:
+            st = self._init_state(problem, N)
+            kinds = np.full(len(pods), K.KIND_FAIL, dtype=np.int32)
+            slots = np.full(len(pods), -1, dtype=np.int32)
+            pending = list(order)
+            timed_out = False
+            overflowed = False
+            while pending:
+                if deadline is not None and time_mod.monotonic() > deadline:
+                    timed_out = True
+                    break
+                xs = self._pod_xs(problem, pending)
+                st, got_kinds, got_slots, got_over = K.solve_scan(tb, st, xs)
+                # one batched device->host fetch (the tunnel charges per call)
+                got_kinds, got_slots, got_over = jax.device_get(
+                    (got_kinds, got_slots, got_over)
+                )
+                if bool(got_over):
+                    overflowed = True
+                    break
+                got_kinds = got_kinds[: len(pending)]
+                got_slots = got_slots[: len(pending)]
+                kinds[pending] = got_kinds
+                slots[pending] = got_slots
+                failed = [i for i, k in zip(pending, got_kinds) if k == K.KIND_FAIL]
+                if len(failed) == len(pending):
+                    break  # no progress: stall (queue.go:52)
+                pending = failed
+            if not overflowed:
                 break
-            xs = self._pod_xs(problem, pending)
-            st, got_kinds, got_slots = K.solve_scan(tb, st, xs)
-            # one batched device->host fetch (the tunnel charges per call)
-            got_kinds, got_slots = jax.device_get((got_kinds, got_slots))
-            got_kinds = got_kinds[: len(pending)]
-            got_slots = got_slots[: len(pending)]
-            kinds[pending] = got_kinds
-            slots[pending] = got_slots
-            failed = [i for i, k in zip(pending, got_kinds) if k == K.KIND_FAIL]
-            if len(failed) == len(pending):
-                break  # no progress: stall (queue.go:52)
-            pending = failed
+            N *= 2  # slots exhausted: re-solve from scratch with room
 
         return self._decode(problem, st, kinds, slots, timed_out)
+
+    def _pod_typeok(self, p: EncodedProblem, tb) -> np.ndarray:
+        """[P, IW] u32 — per pod, the instance types whose requirements
+        intersect the pod's (pairwise screen; the kernel's while_loop stays
+        exact for three-way intersections, offerings, and minValues)."""
+        import jax.numpy as jnp
+
+        I = p.num_types
+        IW = max(1, (I + 31) // 32)
+        P = len(p.pods)
+        out = np.zeros((P, IW), dtype=np.uint32)
+        CH = 2048
+        for lo in range(0, P, CH):
+            hi = min(lo + CH, P)
+            # pow2-pad chunks so compiled shapes are reused across solves
+            pad_to = min(CH, _pow2(hi - lo))
+            idx = np.arange(lo, lo + pad_to) % P
+            chunk = Reqs(*(jnp.asarray(a[idx]) for a in p.preq))
+            got = np.asarray(_typeok_chunk(tb.ireq, tb.va, chunk, iw=IW))
+            out[lo:hi] = got[: hi - lo]
+        return out
 
     # -- tensor construction --------------------------------------------
 
@@ -259,6 +328,7 @@ class TpuScheduler:
         return K.PodX(
             preq=Reqs(*(jnp.asarray(a[idx]) for a in p.preq)),
             prequests=jnp.asarray(p.prequests[idx]),
+            typeok=jnp.asarray(self._typeok[idx]),
             tol_t=jnp.asarray(p.ptol_t[idx]),
             tol_e=jnp.asarray(p.ptol_e[idx]),
             topo_kind=jnp.asarray(p.ptopo_kind[idx]),

@@ -125,6 +125,7 @@ class PodX(NamedTuple):
 
     preq: Reqs
     prequests: jax.Array  # [R]
+    typeok: jax.Array  # [IW] u32 — types whose reqs intersect the pod's
     tol_t: jax.Array  # [T]
     tol_e: jax.Array  # [E]
     topo_kind: jax.Array  # [C]
@@ -495,6 +496,11 @@ def _step(tb: Tables, st: State, x: PodX):
     screen_fits = jnp.all(
         st.crequests + x.prequests <= st.cmax_alloc, axis=-1
     )
+    # pod-vs-type pairwise compat screen: a claim with no surviving type the
+    # pod could ever use is never a candidate — keeps the exact while_loop
+    # below at ~1 iteration (the residual gap is three-way intersections,
+    # offerings, and minValues, which the loop still verifies)
+    screen_types = jnp.any((st.alive & x.typeok) != 0, axis=-1)
     cand_c = (
         st.active
         & x.tol_t[jnp.clip(st.tmpl, 0, max(T - 1, 0))]
@@ -502,6 +508,7 @@ def _step(tb: Tables, st: State, x: PodX):
         & te_c.viable
         & _topo_nonempty_ok(final_c, te_c.touched, tb.va)
         & screen_fits
+        & screen_types
     )
 
     def loop_cond(carry):
@@ -531,12 +538,13 @@ def _step(tb: Tables, st: State, x: PodX):
     def template_branch(_):
         merged_t = intersect(tb.treq, _broadcast_row(x.preq, T), tb.va)
         compat_t = compat(tb.treq, _broadcast_row(x.preq, T), tb.va, True)
-        new_slot_col = jax.lax.dynamic_slice_in_dim(
-            st.h_cnt, E + st.n_claims, 1, axis=1
-        )  # [Gh, 1] — fresh hostname: always zero, but stay general
+        # a fresh claim's hostname counts are always zero (records only ever
+        # target committed slots < n_claims); reading h_cnt at E+n_claims
+        # would clamp at the array edge when slots are exhausted and corrupt
+        # the overflow signal below
         te_t = _eval_topology(
             merged_t,
-            jnp.broadcast_to(new_slot_col, (st.h_cnt.shape[0], T)),
+            jnp.zeros((st.h_cnt.shape[0], T), st.h_cnt.dtype),
             nonempty_h,
             x,
             st,
@@ -555,28 +563,38 @@ def _step(tb: Tables, st: State, x: PodX):
             lambda f, a, tot: _type_filter(f, a, tot, tb), in_axes=(0, 0, 0)
         )(final_t, talive, totals)
         t_minok = jax.vmap(lambda f, fi: _min_values_ok(f, fi, tb))(final_t, t_final_i)
-        viable_t = (
+        viable_nogate = (
             compat_t
             & te_t.viable
             & _topo_nonempty_ok(final_t, te_t.touched, tb.va)
             & x.tol_t
             & jnp.any(t_final_i, axis=-1)
             & t_minok
-            & (st.n_claims < N)
         )
+        viable_t = viable_nogate & (st.n_claims < N)
         slot = jnp.argmin(jnp.where(viable_t, jnp.arange(T), INF_I))
-        return jnp.any(viable_t), slot, _row(final_t, slot), t_final_i[slot]
+        # a viable template exists but every claim slot is taken: the host
+        # must re-solve with more slots (adaptive-N overflow signal)
+        overflow = jnp.any(viable_nogate) & ~jnp.any(viable_t)
+        return jnp.any(viable_t), slot, _row(final_t, slot), t_final_i[slot], overflow
 
     def no_template(_):
         zero_req = jax.tree.map(
             lambda a: jnp.zeros(a.shape[1:], a.dtype), tb.treq
         )
-        return jnp.zeros((), bool), jnp.int32(0), zero_req, jnp.zeros(I, bool)
+        return (
+            jnp.zeros((), bool),
+            jnp.int32(0),
+            zero_req,
+            jnp.zeros(I, bool),
+            jnp.zeros((), bool),
+        )
 
-    found_t, slot_t, final_tn, alive_tn = jax.lax.cond(
+    found_t, slot_t, final_tn, alive_tn, overflow = jax.lax.cond(
         need_new, template_branch, no_template, None
     )
     found_t = found_t & need_new
+    overflow = overflow & need_new
 
     kind = jnp.where(
         found_e,
@@ -685,12 +703,14 @@ def _step(tb: Tables, st: State, x: PodX):
         slot_e,
         jnp.where(kind == KIND_CLAIM, slot_c, jnp.where(kind == KIND_NEW, m, -1)),
     )
-    return new_state, (kind, out_slot)
+    return new_state, (kind, out_slot, overflow)
 
 
 @functools.partial(jax.jit, static_argnames=())
 def solve_scan(tb: Tables, st: State, xs: PodX):
-    """Run the greedy pack over a pod batch; returns (state, kinds, slots)."""
+    """Run the greedy pack over a pod batch; returns
+    (state, kinds, slots, overflowed) — overflowed means some pod failed
+    only because claim slots ran out (host should grow N and re-solve)."""
     step = functools.partial(_step, tb)
-    st, (kinds, slots) = jax.lax.scan(step, st, xs)
-    return st, kinds, slots
+    st, (kinds, slots, overflow) = jax.lax.scan(step, st, xs)
+    return st, kinds, slots, jnp.any(overflow)

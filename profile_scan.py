@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Scaling probe for the solver scan: how does per-pod step time vary with
+claim-slot count N, instance-type count I, and pod count? Distinguishes
+per-op dispatch overhead (flat in N) from bandwidth (linear in N)."""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=2048)
+    ap.add_argument("--types", type=int, default=500)
+    ap.add_argument("--slots", type=int, default=0, help="claim slots override")
+    args = ap.parse_args()
+
+    import jax
+
+    from bench import build_universe, make_problem
+    from karpenter_tpu.solver import tpu_kernel as K
+    from karpenter_tpu.solver.tpu import TpuScheduler, _pow2
+    from karpenter_tpu.solver.tpu_problem import encode_problem
+
+    its = build_universe(args.types)
+    print(f"universe: {len(its)} types")
+    node_pool, pods, topo = make_problem(args.pods, its)
+    sched = TpuScheduler([node_pool], {"default": its}, topo)
+    problem = encode_problem(sched.oracle, pods)
+    for p in pods:
+        sched.oracle._update_cached_pod_data(p)
+
+    N = args.slots or _pow2(len(pods))
+    tb = sched._tables(problem)
+    sched._typeok = sched._pod_typeok(problem, tb)
+    st = sched._init_state(problem, N)
+    xs = sched._pod_xs(problem, list(range(len(pods))))
+    print(
+        f"P={len(pods)} N={N} I={problem.num_types} T={problem.num_templates} "
+        f"TW={problem.vocab.total_words} K={problem.vocab.num_keys} "
+        f"Gv={len(problem.vgroups)} Gh={len(problem.hgroups)} "
+        f"C={problem.ptopo_kind.shape[1]}"
+    )
+
+    t0 = time.monotonic()
+    out = K.solve_scan(tb, st, xs)
+    jax.block_until_ready(out)
+    t_compile = time.monotonic() - t0
+    print(f"compile+run: {t_compile:.1f}s")
+
+    t0 = time.monotonic()
+    st2, kinds, slots, _over = K.solve_scan(tb, st, xs)
+    jax.block_until_ready((st2, kinds, slots))
+    t = time.monotonic() - t0
+    kinds = np.asarray(kinds)
+    n_fail = int(np.sum(kinds == K.KIND_FAIL))
+    print(
+        f"steady: {t:.3f}s for {xs.valid.shape[0]} steps -> "
+        f"{1e6 * t / xs.valid.shape[0]:.0f} us/step, "
+        f"{np.sum(np.asarray(xs.valid)) / t:.0f} pods/s "
+        f"(claims={int(st2.n_claims)}, fail={n_fail})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,238 @@
+"""End-to-end control plane: pending pods -> NodeClaims -> Nodes -> bound
+pods with no manual scheduler calls (VERDICT round-1 item 4; reference flow
+SURVEY.md §3.1).
+
+Also unit-level coverage for the state cache, batcher, lifecycle state
+machine, KWOK provider, volume topology, and node termination."""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import (
+    COND_INITIALIZED,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+    LabelSelector,
+    PersistentVolumeClaim,
+    Pod,
+    PodDisruptionBudget,
+    PodPhase,
+    StorageClass,
+)
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider, construct_instance_types
+from karpenter_tpu.controllers.kube import FakeClock
+from karpenter_tpu.controllers.operator import Operator
+from karpenter_tpu.controllers.state import UNREGISTERED_TAINT
+from karpenter_tpu.testing import fixtures
+
+
+def small_operator(**kw) -> Operator:
+    clock = FakeClock()
+    op = Operator(clock=clock, force_oracle=kw.pop("force_oracle", True), **kw)
+    op.cloud.types = construct_instance_types(sizes=[2, 8, 32])
+    op.cloud._by_name = {it.name: it.name and it for it in op.cloud.types}
+    return op
+
+
+def test_e2e_pending_pods_to_bound_pods():
+    """The headline flow: create a NodePool and pods, tick the operator,
+    and observe claims -> nodes -> bindings with no manual scheduling."""
+    op = small_operator()
+    fixtures.reset_rng(5)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    pods = fixtures.make_generic_pods(10)
+    for p in pods:
+        op.kube.create("Pod", p)
+
+    ticks = op.run_until_settled(max_ticks=30)
+    assert op.settled(), f"not settled after {ticks} ticks"
+
+    claims = op.kube.list("NodeClaim")
+    nodes = op.kube.list("Node")
+    assert claims, "no NodeClaims created"
+    assert nodes, "no Nodes fabricated"
+    assert len(nodes) == len(claims)
+    for c in claims:
+        assert c.status.conditions.get(COND_LAUNCHED) == "True"
+        assert c.status.conditions.get(COND_REGISTERED) == "True"
+        assert c.status.conditions.get(COND_INITIALIZED) == "True"
+        assert c.status.provider_id.startswith("kwok://")
+    # every pod bound to a real node
+    node_names = {n.name for n in nodes}
+    for p in op.kube.list("Pod"):
+        assert p.node_name in node_names, f"pod {p.name} unbound"
+    # nodes carry no unregistered taint and the nodepool label
+    for n in nodes:
+        assert UNREGISTERED_TAINT not in n.taints
+        assert n.metadata.labels[well_known.NODEPOOL_LABEL_KEY] == "default"
+    # state cache reflects the bindings
+    for n in nodes:
+        sn = op.cluster.node_by_name(n.name)
+        assert sn is not None and sn.initialized()
+    assert sum(len(op.cluster.pods_on(n.name)) for n in nodes) == len(pods)
+
+
+def test_e2e_scales_existing_capacity_first():
+    """Second wave of pods lands on existing nodes when they fit."""
+    op = small_operator()
+    fixtures.reset_rng(6)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    for p in fixtures.make_generic_pods(4):
+        op.kube.create("Pod", p)
+    op.run_until_settled(max_ticks=30)
+    n_nodes = len(op.kube.list("Node"))
+    assert n_nodes >= 1
+
+    # tiny pod fits on the existing node -> no new claim
+    p = fixtures.pod(name="late", requests={"cpu": "10m", "memory": "10Mi"})
+    op.kube.create("Pod", p)
+    op.run_until_settled(max_ticks=30)
+    assert op.kube.get("Pod", "late").node_name
+    assert len(op.kube.list("Node")) == n_nodes
+
+
+def test_lifecycle_liveness_deletes_stuck_claims():
+    from karpenter_tpu.cloudprovider.types import CreateError
+
+    op = small_operator()
+    fixtures.reset_rng(7)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Pod", fixtures.make_generic_pods(1)[0])
+    # every launch fails
+    op.cloud.create = lambda claim: (_ for _ in ()).throw(
+        CreateError("simulated capacity failure", reason="InsufficientCapacity")
+    )
+    op.step(2.0)
+    op.step(2.0)
+    assert op.kube.list("NodeClaim"), "claim should exist while retrying"
+    # launch TTL elapses -> liveness deletes the claim
+    op.clock.advance(op.opts.launch_ttl_seconds + 1)
+    op.lifecycle.reconcile_all()
+    op.lifecycle.reconcile_all()  # finalizer pass
+    assert not op.kube.list("NodeClaim")
+    assert op.recorder.for_reason("LivenessTimeout")
+
+
+def test_node_termination_drains_and_removes():
+    op = small_operator()
+    fixtures.reset_rng(8)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    for p in fixtures.make_generic_pods(3):
+        op.kube.create("Pod", p)
+    op.run_until_settled(max_ticks=30)
+    node = op.kube.list("Node")[0]
+    claim = op.kube.list("NodeClaim")[0]
+    pods_on_node = [p for p in op.kube.list("Pod") if p.node_name == node.name]
+    assert pods_on_node
+
+    op.kube.delete("NodeClaim", claim.name)
+    for _ in range(12):
+        op.step(2.0)
+    # node + claim gone, instance terminated
+    assert op.kube.try_get("Node", node.name) is None
+    assert op.kube.try_get("NodeClaim", claim.name) is None
+    assert claim.status.provider_id in op.cloud.deleted
+    # evicted workload pods were rescheduled onto a replacement
+    for p in op.kube.list("Pod"):
+        assert p.node_name != node.name
+
+
+def test_pdb_blocks_eviction():
+    op = small_operator()
+    fixtures.reset_rng(9)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    pod = fixtures.pod(name="guarded", labels={"app": "db"}, requests={"cpu": "100m"})
+    op.kube.create("Pod", pod)
+    op.run_until_settled(max_ticks=30)
+    stored = op.kube.get("Pod", "guarded")
+    stored.phase = PodPhase.RUNNING
+    op.kube.update("Pod", stored)
+    op.kube.create(
+        "PodDisruptionBudget",
+        PodDisruptionBudget(
+            metadata=fixtures.pod(name="pdb-db").metadata,
+            selector=LabelSelector(match_labels={"app": "db"}),
+            max_unavailable="0",
+        ),
+    )
+    node = op.kube.list("Node")[0]
+    op.kube.delete("Node", node.name)
+    for _ in range(5):
+        op.termination.reconcile_all()
+    # the pod is still there, eviction blocked by the PDB
+    assert op.kube.get("Pod", "guarded").node_name == node.name
+    assert not op.kube.get("Pod", "guarded").terminating
+    assert op.recorder.for_reason("EvictionBlocked")
+
+
+def test_volume_topology_injection():
+    op = small_operator()
+    fixtures.reset_rng(10)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    sc = StorageClass()
+    sc.metadata.name = "zonal"
+    sc.zones = ["test-zone-b"]
+    op.kube.create("StorageClass", sc)
+    pvc = PersistentVolumeClaim(storage_class_name="zonal")
+    pvc.metadata.name = "data"
+    op.kube.create("PersistentVolumeClaim", pvc)
+
+    p = fixtures.pod(name="zonal-pod", requests={"cpu": "100m"})
+    p.volume_claims = ["data"]
+    op.kube.create("Pod", p)
+    op.run_until_settled(max_ticks=30)
+
+    bound = op.kube.get("Pod", "zonal-pod")
+    assert bound.node_name
+    node = op.kube.get("Node", bound.node_name)
+    assert node.metadata.labels[well_known.TOPOLOGY_ZONE_LABEL_KEY] == "test-zone-b"
+
+
+def test_missing_pvc_blocks_pod():
+    op = small_operator()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    p = fixtures.pod(name="orphan", requests={"cpu": "100m"})
+    p.volume_claims = ["missing"]
+    op.kube.create("Pod", p)
+    op.run_until_settled(max_ticks=5)
+    assert not op.kube.get("Pod", "orphan").node_name
+    assert any(
+        "missing persistent volume claim" in e.message
+        for e in op.recorder.for_reason("FailedScheduling")
+    )
+
+
+def test_batcher_window():
+    clock = FakeClock()
+    from karpenter_tpu.controllers.provisioning import Batcher
+
+    b = Batcher(clock, idle_seconds=1.0, max_seconds=10.0)
+    assert not b.ready()
+    b.trigger("a")
+    assert not b.ready()  # idle window open
+    clock.advance(0.5)
+    b.trigger("b")
+    clock.advance(1.1)
+    assert b.ready()  # idle elapsed since the last distinct trigger
+    b.reset()
+    start = clock.now()
+    # max window forces readiness under constant triggering
+    for i in range(100):
+        b.trigger(f"t{i}")
+        clock.advance(0.2)
+        if b.ready():
+            break
+    assert b.ready()
+    assert clock.now() - start <= 10.0 + 0.3
+
+
+def test_cluster_synced_barrier():
+    op = small_operator()
+    # claims created out-of-band are seen synchronously via informers
+    assert op.cluster.synced(op.kube)
+    fixtures.reset_rng(11)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Pod", fixtures.make_generic_pods(1)[0])
+    assert op.cluster.synced(op.kube)

@@ -348,3 +348,20 @@ def test_fallback_on_preferences():
     t = TpuScheduler([np_], {"default": its}, topo)
     with pytest.raises(UnsupportedBySolver):
         t.solve(pods)
+
+
+def test_adaptive_slots_overflow_retry():
+    """Anti-affinity pods need one claim each; the adaptive claim-slot count
+    starts below that (pods/4) and must grow via the kernel's overflow
+    signal until the solve fits — results identical to the oracle."""
+
+    def make():
+        fixtures.reset_rng(31)
+        its = construct_instance_types(sizes=[2, 8])
+        np_ = fixtures.node_pool(name="default")
+        pods = fixtures.make_pod_anti_affinity_pods(
+            96, well_known.HOSTNAME_LABEL_KEY
+        )
+        return [np_], {"default": its}, pods, None, None
+
+    assert_parity(run_both(make))
