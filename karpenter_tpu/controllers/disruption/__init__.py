@@ -1,0 +1,47 @@
+"""Disruption (consolidation) subsystem — the second consumer of the solver.
+
+Reference /root/reference/pkg/controllers/disruption/. The simulation
+primitive (helpers.simulate_scheduling) routes through the HybridScheduler,
+so consolidation decisions ride the TPU path whenever the problem encodes.
+"""
+
+from karpenter_tpu.controllers.disruption.consolidation import (
+    DriftConsolidation,
+    EmptinessConsolidation,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_tpu.controllers.disruption.controller import DisruptionController
+from karpenter_tpu.controllers.disruption.helpers import (
+    BudgetMapping,
+    build_budget_mapping,
+    build_candidates,
+    simulate_scheduling,
+)
+from karpenter_tpu.controllers.disruption.queue import OrchestrationQueue, Validator
+from karpenter_tpu.controllers.disruption.types import (
+    Candidate,
+    Command,
+    DECISION_DELETE,
+    DECISION_NOOP,
+    DECISION_REPLACE,
+)
+
+__all__ = [
+    "BudgetMapping",
+    "Candidate",
+    "Command",
+    "DECISION_DELETE",
+    "DECISION_NOOP",
+    "DECISION_REPLACE",
+    "DisruptionController",
+    "DriftConsolidation",
+    "EmptinessConsolidation",
+    "MultiNodeConsolidation",
+    "OrchestrationQueue",
+    "SingleNodeConsolidation",
+    "Validator",
+    "build_budget_mapping",
+    "build_candidates",
+    "simulate_scheduling",
+]

@@ -1,0 +1,108 @@
+"""Disruption candidates and commands.
+
+Reference /root/reference/pkg/controllers/disruption/types.go:73-216.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import (
+    COND_CONSOLIDATABLE,
+    COND_DRIFTED,
+    COND_EMPTY,
+    NodePool,
+    Pod,
+)
+from karpenter_tpu.controllers.state import StateNode
+from karpenter_tpu.solver.nodes import SchedulingNodeClaim
+
+# disruption reasons (reference apis/v1 DisruptionReason)
+REASON_UNDERUTILIZED = "underutilized"
+REASON_EMPTY = "empty"
+REASON_DRIFTED = "drifted"
+
+
+@dataclass
+class Candidate:
+    """types.go:73 Candidate: a disruptable node plus everything the
+    decision needs."""
+
+    state_node: StateNode
+    node_pool: NodePool
+    instance_type_name: str
+    capacity_type: str
+    zone: str
+    price: float  # current offering price (MAX if unknown)
+    reschedulable_pods: list[Pod] = field(default_factory=list)
+    disruption_cost: float = 0.0
+
+    @property
+    def name(self) -> str:
+        return self.state_node.name
+
+    @property
+    def nodepool_name(self) -> str:
+        return self.node_pool.name
+
+    def claim_name(self) -> Optional[str]:
+        claim = self.state_node.node_claim
+        return claim.name if claim is not None else None
+
+    def is_empty(self) -> bool:
+        return not self.reschedulable_pods
+
+    def condition(self, cond: str) -> bool:
+        claim = self.state_node.node_claim
+        return claim is not None and claim.status.conditions.get(cond) == "True"
+
+    def consolidatable(self) -> bool:
+        return self.condition(COND_CONSOLIDATABLE)
+
+    def drifted(self) -> bool:
+        return self.condition(COND_DRIFTED)
+
+    def empty_condition(self) -> bool:
+        return self.condition(COND_EMPTY)
+
+
+DECISION_DELETE = "delete"
+DECISION_REPLACE = "replace"
+DECISION_NOOP = "no-op"
+
+
+@dataclass
+class Command:
+    """types.go:150 Command: what to do with a candidate set."""
+
+    reason: str
+    candidates: list[Candidate] = field(default_factory=list)
+    replacements: list[SchedulingNodeClaim] = field(default_factory=list)
+
+    @property
+    def decision(self) -> str:
+        if not self.candidates:
+            return DECISION_NOOP
+        return DECISION_REPLACE if self.replacements else DECISION_DELETE
+
+    def __repr__(self) -> str:
+        return (
+            f"Command({self.decision}, reason={self.reason}, "
+            f"candidates={[c.name for c in self.candidates]}, "
+            f"replacements={len(self.replacements)})"
+        )
+
+
+def disruption_cost(pods: list[Pod], clock=None) -> float:
+    """disruptionCost (reference disruption/helpers.go:300-320): pods with
+    higher priority and explicit do-not-disrupt preferences cost more to
+    move; the reference also scales by remaining pod lifetime."""
+    cost = 0.0
+    for p in pods:
+        cost += 1.0
+        cost += p.priority / 1e6
+        if p.metadata.annotations.get(well_known.DO_NOT_DISRUPT_ANNOTATION_KEY) == "true":
+            cost += 10.0
+    return cost

@@ -63,6 +63,8 @@ class NodeClaimLifecycle:
         self.recorder = recorder or Recorder(clock)
         # claim name -> first-seen time, for liveness TTLs with FakeClock
         self._first_seen: dict[str, float] = {}
+        # optional hook: nodepool registration-health ring buffer
+        self.registration_health = None
 
     def reconcile_all(self) -> None:
         for claim in self.kube.list("NodeClaim"):
@@ -98,10 +100,14 @@ class NodeClaimLifecycle:
         except CreateError as e:
             nodepool = claim.nodepool_name or ""
             LAUNCH_FAILURES.inc({"nodepool": nodepool, "reason": e.reason})
+            if self.registration_health is not None:
+                self.registration_health.record_launch(nodepool, False)
             self.recorder.publish(
                 Event("NodeClaim", claim.name, "Warning", "LaunchFailed", str(e))
             )
             return self._liveness(claim)
+        if self.registration_health is not None:
+            self.registration_health.record_launch(claim.nodepool_name or "", True)
         claim.status.provider_id = launched.status.provider_id
         claim.status.node_name = launched.status.node_name
         claim.status.capacity = dict(launched.status.capacity)
@@ -215,10 +221,10 @@ class NodeClaimLifecycle:
     # -- helpers ----------------------------------------------------------
 
     def _node_for(self, claim: NodeClaim):
-        if claim.status.provider_id:
-            for node in self.kube.list("Node"):
-                if node.provider_id == claim.status.provider_id:
-                    return node
+        # the cluster cache indexes provider ids; avoid a deep-copy List scan
+        sn = self.cluster.node_by_claim_name(claim.name)
+        if sn is not None and sn.node is not None:
+            return self.kube.try_get("Node", sn.node.name)
         if claim.status.node_name:
             return self.kube.try_get("Node", claim.status.node_name)
         return None

@@ -1,0 +1,319 @@
+"""NodeClaim auxiliary controllers: disruption conditions, expiration,
+garbage collection, pod events, consistency, hydration.
+
+Reference /root/reference/pkg/controllers/nodeclaim/:
+- disruption/consolidation.go:38 (Consolidatable after consolidateAfter of
+  pod-event quiet), disruption/drift.go:50-183 (Drifted via provider +
+  nodepool hash)
+- expiration/controller.go:57-97 (expireAfter deletes)
+- garbagecollection/controller.go:60-119 (cloud<->cluster reconciliation)
+- podevents/controller.go:63-99 (lastPodEventTime stamping)
+- consistency/controller.go:79-150 (invariant checks)
+- hydration/controller.go:56-77 (field backfill)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import (
+    COND_CONSISTENT_STATE_FOUND,
+    COND_CONSOLIDATABLE,
+    COND_DRIFTED,
+    COND_EMPTY,
+    COND_INITIALIZED,
+    NodeClaim,
+    NodePool,
+)
+from karpenter_tpu.controllers.kube import Conflict, NotFound, SimKube
+from karpenter_tpu.controllers.state import Cluster, is_reschedulable
+from karpenter_tpu.events import Event, Recorder
+from karpenter_tpu import metrics
+
+NODEPOOL_HASH_VERSION = "v1"
+
+CLAIMS_EXPIRED = metrics.REGISTRY.counter(
+    "karpenter_nodeclaims_expired_total", "NodeClaims deleted by expiration.", ("nodepool",)
+)
+CLAIMS_GARBAGE_COLLECTED = metrics.REGISTRY.counter(
+    "karpenter_nodeclaims_garbage_collected_total",
+    "NodeClaims or instances removed by garbage collection.",
+    ("direction",),
+)
+
+
+def nodepool_hash(np: NodePool) -> str:
+    """Static-field drift hash (reference nodepool.go Hash): the fields of
+    the template that force replacement when changed."""
+    spec = np.template
+    payload = {
+        "labels": dict(sorted(spec.labels.items())),
+        "annotations": dict(sorted(spec.annotations.items())),
+        "taints": sorted(
+            (t.key, t.value, str(t.effect)) for t in spec.taints
+        ),
+        "startup_taints": sorted(
+            (t.key, t.value, str(t.effect)) for t in spec.startup_taints
+        ),
+        "node_class_ref": spec.node_class_ref,
+        "expire_after": spec.expire_after_seconds,
+        "tgp": spec.termination_grace_period_seconds,
+    }
+    return hashlib.sha256(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
+
+
+class NodeClaimDisruptionConditions:
+    """nodeclaim/disruption: stamps Consolidatable / Drifted / Empty."""
+
+    def __init__(self, kube: SimKube, cluster: Cluster, cloud, clock):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud = cloud
+        self.clock = clock
+
+    def reconcile_all(self) -> None:
+        nodepools = {np.name: np for np in self.kube.list("NodePool")}
+        for claim in self.kube.list("NodeClaim"):
+            self.reconcile(claim, nodepools)
+
+    def reconcile(self, claim: NodeClaim, nodepools: dict) -> None:
+        if claim.metadata.deletion_timestamp is not None:
+            return
+        np = nodepools.get(claim.nodepool_name)
+        if np is None:
+            return
+        changed = False
+        changed |= self._consolidatable(claim, np)
+        changed |= self._drifted(claim, np)
+        changed |= self._empty(claim)
+        if changed:
+            try:
+                self.kube.update("NodeClaim", claim)
+            except (Conflict, NotFound):
+                pass
+
+    def _consolidatable(self, claim: NodeClaim, np: NodePool) -> bool:
+        """consolidation.go:38: quiet (no pod events) for consolidateAfter."""
+        if claim.status.conditions.get(COND_INITIALIZED) != "True":
+            return False
+        quiet_since = claim.status.last_pod_event_time or claim.metadata.creation_timestamp
+        consolidatable = (
+            self.clock.now() - quiet_since
+            >= np.disruption.consolidate_after_seconds
+        )
+        want = "True" if consolidatable else "False"
+        if claim.status.conditions.get(COND_CONSOLIDATABLE) != want:
+            claim.status.conditions[COND_CONSOLIDATABLE] = want
+            return True
+        return False
+
+    def _drifted(self, claim: NodeClaim, np: NodePool) -> bool:
+        """drift.go:50: provider drift OR static-field hash drift."""
+        drifted = ""
+        provider_reason = self.cloud.is_drifted(claim)
+        if provider_reason:
+            drifted = provider_reason
+        else:
+            claim_hash = claim.metadata.annotations.get(
+                well_known.NODEPOOL_HASH_ANNOTATION_KEY
+            )
+            claim_ver = claim.metadata.annotations.get(
+                well_known.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
+            )
+            if (
+                claim_hash is not None
+                and claim_ver == NODEPOOL_HASH_VERSION
+                and claim_hash != nodepool_hash(np)
+            ):
+                drifted = "NodePoolDrifted"
+        want = "True" if drifted else "False"
+        if claim.status.conditions.get(COND_DRIFTED) != want:
+            claim.status.conditions[COND_DRIFTED] = want
+            return True
+        return False
+
+    def _empty(self, claim: NodeClaim) -> bool:
+        if claim.status.conditions.get(COND_INITIALIZED) != "True":
+            return False
+        node_name = claim.status.node_name
+        pods = [
+            p
+            for p in self.cluster.pods_on(node_name)
+            if is_reschedulable(p)
+        ] if node_name else []
+        want = "True" if not pods else "False"
+        if claim.status.conditions.get(COND_EMPTY) != want:
+            claim.status.conditions[COND_EMPTY] = want
+            return True
+        return False
+
+
+class PodEvents:
+    """nodeclaim/podevents: stamp lastPodEventTime whenever a pod binds to
+    or leaves the claim's node (controller.go:63)."""
+
+    def __init__(self, kube: SimKube, cluster: Cluster, clock):
+        self.kube = kube
+        self.cluster = cluster
+        self.clock = clock
+        self._last_counts: dict[str, int] = {}
+
+    def reconcile_all(self) -> None:
+        for claim in self.kube.list("NodeClaim"):
+            node_name = claim.status.node_name
+            if not node_name:
+                continue
+            n = len(self.cluster.pods_on(node_name))
+            if self._last_counts.get(claim.name) != n:
+                self._last_counts[claim.name] = n
+                claim.status.last_pod_event_time = self.clock.now()
+                try:
+                    self.kube.update("NodeClaim", claim)
+                except (Conflict, NotFound):
+                    pass
+
+
+class Expiration:
+    """nodeclaim/expiration: delete claims older than expireAfter
+    (controller.go:57)."""
+
+    def __init__(self, kube: SimKube, clock, recorder: Optional[Recorder] = None):
+        self.kube = kube
+        self.clock = clock
+        self.recorder = recorder
+
+    def reconcile_all(self) -> int:
+        expired = 0
+        for claim in self.kube.list("NodeClaim"):
+            if claim.metadata.deletion_timestamp is not None:
+                continue
+            if claim.expire_after_seconds is None:
+                continue
+            age = self.clock.now() - claim.metadata.creation_timestamp
+            if age < claim.expire_after_seconds:
+                continue
+            self.kube.delete("NodeClaim", claim.name)
+            CLAIMS_EXPIRED.inc({"nodepool": claim.nodepool_name or ""})
+            if self.recorder:
+                self.recorder.publish(
+                    Event(
+                        "NodeClaim", claim.name, "Normal", "Expired",
+                        f"expired after {age:.0f}s",
+                    )
+                )
+            expired += 1
+        return expired
+
+
+class GarbageCollection:
+    """nodeclaim/garbagecollection: both directions (controller.go:60) —
+    cloud instances without claims are terminated; launched claims whose
+    instances vanished are deleted."""
+
+    def __init__(self, kube: SimKube, cloud, clock):
+        self.kube = kube
+        self.cloud = cloud
+        self.clock = clock
+
+    def reconcile(self) -> tuple[int, int]:
+        claims = self.kube.list("NodeClaim")
+        claim_pids = {
+            c.status.provider_id for c in claims if c.status.provider_id
+        }
+        # direction 1: instances with no claim
+        orphans = 0
+        for instance in list(self.cloud.list()):
+            pid = instance.status.provider_id
+            if pid and pid not in claim_pids:
+                try:
+                    self.cloud.delete(instance)
+                    orphans += 1
+                    CLAIMS_GARBAGE_COLLECTED.inc({"direction": "instance"})
+                except Exception:
+                    pass
+        # direction 2: launched claims whose instance vanished
+        live_pids = {
+            i.status.provider_id for i in self.cloud.list() if i.status.provider_id
+        }
+        lost = 0
+        for claim in claims:
+            pid = claim.status.provider_id
+            if not pid or claim.metadata.deletion_timestamp is not None:
+                continue
+            if pid not in live_pids:
+                self.kube.delete("NodeClaim", claim.name)
+                lost += 1
+                CLAIMS_GARBAGE_COLLECTED.inc({"direction": "nodeclaim"})
+        return orphans, lost
+
+
+class Consistency:
+    """nodeclaim/consistency: periodic invariant checks (nodeshape.go):
+    the node's shape must match what the claim promised."""
+
+    def __init__(self, kube: SimKube, cluster: Cluster, recorder: Optional[Recorder] = None):
+        self.kube = kube
+        self.cluster = cluster
+        self.recorder = recorder
+
+    def reconcile_all(self) -> list[str]:
+        problems = []
+        for claim in self.kube.list("NodeClaim"):
+            if claim.status.conditions.get(COND_INITIALIZED) != "True":
+                continue
+            issue = self._check(claim)
+            want = "False" if issue else "True"
+            if claim.status.conditions.get(COND_CONSISTENT_STATE_FOUND) != want:
+                claim.status.conditions[COND_CONSISTENT_STATE_FOUND] = want
+                try:
+                    self.kube.update("NodeClaim", claim)
+                except (Conflict, NotFound):
+                    pass
+            if issue:
+                problems.append(f"{claim.name}: {issue}")
+                if self.recorder:
+                    self.recorder.publish(
+                        Event("NodeClaim", claim.name, "Warning", "FailedConsistencyCheck", issue)
+                    )
+        return problems
+
+    def _check(self, claim: NodeClaim) -> Optional[str]:
+        node = self.kube.try_get("Node", claim.status.node_name)
+        if node is None:
+            return "node missing for initialized claim"
+        for name, want in claim.status.capacity.items():
+            got = node.capacity.get(name, 0)
+            if got < want:
+                return (
+                    f"node capacity {name} {got} below claim capacity {want}"
+                )
+        return None
+
+
+class Hydration:
+    """nodeclaim+node hydration (upgrade backfill): ensure objects carry the
+    fields newer controllers expect — here the nodepool hash-version
+    annotation and the nodepool label on nodes."""
+
+    def __init__(self, kube: SimKube):
+        self.kube = kube
+
+    def reconcile_all(self) -> None:
+        nodepools = {np.name: np for np in self.kube.list("NodePool")}
+        for claim in self.kube.list("NodeClaim"):
+            np = nodepools.get(claim.nodepool_name)
+            if np is None:
+                continue
+            ann = claim.metadata.annotations
+            if well_known.NODEPOOL_HASH_ANNOTATION_KEY not in ann:
+                ann[well_known.NODEPOOL_HASH_ANNOTATION_KEY] = nodepool_hash(np)
+                ann[well_known.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = (
+                    NODEPOOL_HASH_VERSION
+                )
+                try:
+                    self.kube.update("NodeClaim", claim)
+                except (Conflict, NotFound):
+                    pass

@@ -1,0 +1,246 @@
+"""NodePool auxiliary controllers + node health (repair).
+
+Reference /root/reference/pkg/controllers/nodepool/{hash,counter,readiness,
+registrationhealth,validation} and node/health/controller.go:106-203.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import COND_NODE_CLASS_READY, COND_NODE_REGISTRATION_HEALTHY
+from karpenter_tpu.controllers.kube import Conflict, NotFound, SimKube
+from karpenter_tpu.controllers.nodeclaim_aux import NODEPOOL_HASH_VERSION, nodepool_hash
+from karpenter_tpu.controllers.state import Cluster
+from karpenter_tpu.events import Event, Recorder
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu import metrics
+
+NODEPOOL_USAGE = metrics.REGISTRY.gauge(
+    "karpenter_nodepools_usage",
+    "Resource usage per nodepool.",
+    ("nodepool", "resource_type"),
+)
+NODEPOOL_NODE_COUNT = metrics.REGISTRY.gauge(
+    "karpenter_nodepools_node_count", "Node count per nodepool.", ("nodepool",)
+)
+NODES_REPAIRED = metrics.REGISTRY.counter(
+    "karpenter_nodes_repaired_total", "Nodes force-deleted by auto-repair.", ("condition",)
+)
+
+
+class NodePoolHash:
+    """nodepool/hash: propagate the drift hash onto the NodePool annotations
+    (hash/controller.go:55). NodeClaims pick it up at hydration/creation."""
+
+    def __init__(self, kube: SimKube):
+        self.kube = kube
+
+    def reconcile_all(self) -> None:
+        for np in self.kube.list("NodePool"):
+            want = nodepool_hash(np)
+            ann = np.metadata.annotations
+            if (
+                ann.get(well_known.NODEPOOL_HASH_ANNOTATION_KEY) == want
+                and ann.get(well_known.NODEPOOL_HASH_VERSION_ANNOTATION_KEY)
+                == NODEPOOL_HASH_VERSION
+            ):
+                continue
+            ann[well_known.NODEPOOL_HASH_ANNOTATION_KEY] = want
+            ann[well_known.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = NODEPOOL_HASH_VERSION
+            try:
+                self.kube.update("NodePool", np)
+            except (Conflict, NotFound):
+                pass
+
+
+class NodePoolCounter:
+    """nodepool/counter: aggregate owned node resources into NodePool status
+    (counter/controller.go:70)."""
+
+    def __init__(self, kube: SimKube, cluster: Cluster):
+        self.kube = kube
+        self.cluster = cluster
+
+    def reconcile_all(self) -> None:
+        totals: dict[str, dict] = {}
+        counts: dict[str, int] = {}
+        for sn in self.cluster.state_nodes():
+            np_name = sn.nodepool_name
+            if np_name is None:
+                continue
+            totals[np_name] = res.merge(totals.get(np_name, {}), sn.capacity())
+            counts[np_name] = counts.get(np_name, 0) + 1
+        for np in self.kube.list("NodePool"):
+            want_res = totals.get(np.name, {})
+            want_count = counts.get(np.name, 0)
+            if np.status_resources == want_res and np.status_node_count == want_count:
+                continue
+            np.status_resources = want_res
+            np.status_node_count = want_count
+            try:
+                self.kube.update("NodePool", np)
+            except (Conflict, NotFound):
+                continue
+            NODEPOOL_NODE_COUNT.set(float(want_count), {"nodepool": np.name})
+            for rname, v in want_res.items():
+                NODEPOOL_USAGE.set(
+                    float(v), {"nodepool": np.name, "resource_type": rname}
+                )
+
+
+class NodePoolReadiness:
+    """nodepool/readiness: NodeClassReady condition (readiness/controller.go:53).
+    In-tree providers have no external NodeClass objects, so readiness is a
+    provider callback (ready unless the provider objects)."""
+
+    def __init__(self, kube: SimKube, cloud):
+        self.kube = kube
+        self.cloud = cloud
+
+    def reconcile_all(self) -> None:
+        for np in self.kube.list("NodePool"):
+            ready = True
+            checker = getattr(self.cloud, "node_class_ready", None)
+            if checker is not None:
+                ready = bool(checker(np))
+            want = "True" if ready else "False"
+            if np.conditions.get(COND_NODE_CLASS_READY) != want:
+                np.conditions[COND_NODE_CLASS_READY] = want
+                try:
+                    self.kube.update("NodePool", np)
+                except (Conflict, NotFound):
+                    pass
+
+
+class RegistrationHealth:
+    """nodepool/registrationhealth: the NodeRegistrationHealthy condition
+    from a launch/registration failure ring buffer
+    (registrationhealth/controller.go:59 + pkg/state/nodepoolhealth)."""
+
+    WINDOW = 10  # ring buffer size (tracker.go)
+    THRESHOLD = 0.5  # unhealthy when >50% of the window failed
+
+    def __init__(self, kube: SimKube):
+        self.kube = kube
+        self._window: dict[str, deque] = {}
+
+    def record_launch(self, nodepool: str, ok: bool) -> None:
+        buf = self._window.setdefault(nodepool, deque(maxlen=self.WINDOW))
+        buf.append(ok)
+
+    def reconcile_all(self) -> None:
+        for np in self.kube.list("NodePool"):
+            buf = self._window.get(np.name)
+            if not buf:
+                continue
+            failure_rate = 1.0 - (sum(buf) / len(buf))
+            healthy = not (
+                len(buf) >= self.WINDOW // 2 and failure_rate > self.THRESHOLD
+            )
+            want = "True" if healthy else "False"
+            if np.conditions.get(COND_NODE_REGISTRATION_HEALTHY) != want:
+                np.conditions[COND_NODE_REGISTRATION_HEALTHY] = want
+                try:
+                    self.kube.update("NodePool", np)
+                except (Conflict, NotFound):
+                    pass
+
+
+class NodePoolValidation:
+    """nodepool/validation: runtime spec validation (validation/controller.go:51)."""
+
+    def __init__(self, kube: SimKube, recorder: Optional[Recorder] = None):
+        self.kube = kube
+        self.recorder = recorder
+
+    def reconcile_all(self) -> dict[str, str]:
+        problems: dict[str, str] = {}
+        for np in self.kube.list("NodePool"):
+            err = self.validate(np)
+            if err is not None:
+                problems[np.name] = err
+                if self.recorder:
+                    self.recorder.publish(
+                        Event("NodePool", np.name, "Warning", "FailedValidation", err)
+                    )
+        return problems
+
+    @staticmethod
+    def validate(np) -> Optional[str]:
+        for budget in np.disruption.budgets:
+            raw = budget.nodes.strip()
+            try:
+                if raw.endswith("%"):
+                    v = float(raw[:-1])
+                    if not 0 <= v <= 100:
+                        return f"budget percent out of range: {raw}"
+                else:
+                    if int(raw) < 0:
+                        return f"budget count negative: {raw}"
+            except ValueError:
+                return f"invalid budget nodes value: {raw!r}"
+        if np.disruption.consolidate_after_seconds < 0:
+            return "consolidateAfter must be >= 0"
+        if np.weight < 0 or np.weight > 100:
+            return "weight must be in [0, 100]"
+        return None
+
+
+class NodeHealth:
+    """node/health: force-delete nodes whose provider repair-policy
+    conditions stayed unhealthy past the toleration window
+    (health/controller.go:106). Gated by the NodeRepair feature flag."""
+
+    def __init__(self, kube: SimKube, cluster: Cluster, cloud, clock, recorder=None):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud = cloud
+        self.clock = clock
+        self.recorder = recorder
+        self._unhealthy_since: dict[tuple[str, str], float] = {}
+
+    def reconcile_all(self) -> int:
+        policies = self.cloud.repair_policies()
+        if not policies:
+            return 0
+        repaired = 0
+        now = self.clock.now()
+        for node in self.kube.list("Node"):
+            if node.metadata.deletion_timestamp is not None:
+                continue
+            for policy in policies:
+                key = (node.name, policy.condition_type)
+                status = node.conditions.get(policy.condition_type)
+                if status != policy.condition_status:
+                    self._unhealthy_since.pop(key, None)
+                    continue
+                since = self._unhealthy_since.setdefault(key, now)
+                if now - since < policy.toleration_seconds:
+                    continue
+                sn = self.cluster.node_by_name(node.name)
+                claim = sn.node_claim if sn is not None else None
+                if claim is not None:
+                    try:
+                        self.kube.delete("NodeClaim", claim.name)
+                    except NotFound:
+                        pass
+                else:
+                    try:
+                        self.kube.delete("Node", node.name)
+                    except NotFound:
+                        pass
+                NODES_REPAIRED.inc({"condition": policy.condition_type})
+                if self.recorder:
+                    self.recorder.publish(
+                        Event(
+                            "Node", node.name, "Warning", "NodeRepair",
+                            f"condition {policy.condition_type} unhealthy for "
+                            f"{now - since:.0f}s; replacing",
+                        )
+                    )
+                repaired += 1
+                break
+        return repaired

@@ -13,8 +13,25 @@ from typing import Optional
 
 from karpenter_tpu.api.objects import Pod
 from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.disruption import DisruptionController
 from karpenter_tpu.controllers.kube import FakeClock, SimKube
 from karpenter_tpu.controllers.lifecycle import NodeClaimLifecycle
+from karpenter_tpu.controllers.nodeclaim_aux import (
+    Consistency,
+    Expiration,
+    GarbageCollection,
+    Hydration,
+    NodeClaimDisruptionConditions,
+    PodEvents,
+)
+from karpenter_tpu.controllers.nodepool_aux import (
+    NodeHealth,
+    NodePoolCounter,
+    NodePoolHash,
+    NodePoolReadiness,
+    NodePoolValidation,
+    RegistrationHealth,
+)
 from karpenter_tpu.controllers.provisioning import Provisioner
 from karpenter_tpu.controllers.state import Cluster, is_provisionable, wire_informers
 from karpenter_tpu.controllers.termination import NodeTermination
@@ -54,7 +71,36 @@ class Operator:
         self.termination = NodeTermination(
             self.kube, self.cluster, self.cloud, self.clock, self.recorder
         )
-        self.disruption = None  # attached by karpenter_tpu.controllers.disruption
+        self.disruption = DisruptionController(
+            self.kube,
+            self.cluster,
+            self.cloud,
+            self.provisioner,
+            self.clock,
+            self.opts,
+            self.recorder,
+            force_oracle=force_oracle,
+        )
+        # aux controllers (reference pkg/controllers/controllers.go:66 registry)
+        self.nodepool_hash = NodePoolHash(self.kube)
+        self.nodepool_counter = NodePoolCounter(self.kube, self.cluster)
+        self.nodepool_readiness = NodePoolReadiness(self.kube, self.cloud)
+        self.nodepool_validation = NodePoolValidation(self.kube, self.recorder)
+        self.registration_health = RegistrationHealth(self.kube)
+        self.lifecycle.registration_health = self.registration_health
+        self.hydration = Hydration(self.kube)
+        self.pod_events = PodEvents(self.kube, self.cluster, self.clock)
+        self.claim_conditions = NodeClaimDisruptionConditions(
+            self.kube, self.cluster, self.cloud, self.clock
+        )
+        self.expiration = Expiration(self.kube, self.clock, self.recorder)
+        self.garbage_collection = GarbageCollection(self.kube, self.cloud, self.clock)
+        self.consistency = Consistency(self.kube, self.cluster, self.recorder)
+        self.node_health = (
+            NodeHealth(self.kube, self.cluster, self.cloud, self.clock, self.recorder)
+            if self.opts.feature_gates.node_repair
+            else None
+        )
 
         # trigger controllers (provisioning/controller.go:44): watch events
         def triggers(event: str, kind: str, obj) -> None:
@@ -77,8 +123,21 @@ class Operator:
             self.clock.advance(advance_seconds)
         if hasattr(self.cloud, "reconcile"):
             self.cloud.reconcile()  # KWOK registration delays
+        self.nodepool_hash.reconcile_all()
+        self.nodepool_readiness.reconcile_all()
+        self.nodepool_validation.reconcile_all()
+        self.registration_health.reconcile_all()
+        self.hydration.reconcile_all()
         self.lifecycle.reconcile_all()
         self.termination.reconcile_all()
+        self.expiration.reconcile_all()
+        self.garbage_collection.reconcile()
+        self.pod_events.reconcile_all()
+        self.claim_conditions.reconcile_all()
+        self.nodepool_counter.reconcile_all()
+        self.consistency.reconcile_all()
+        if self.node_health is not None:
+            self.node_health.reconcile_all()
         # the pod trigger controller requeues provisionable pods continuously
         # (provisioning/controller.go:60); without it a pod that failed or
         # awaits a node would never reopen the batch window

@@ -25,7 +25,6 @@ from karpenter_tpu.api.objects import (
     NodeClaim,
     NodeSelectorRequirement,
     NodeSelectorTerm,
-    ObjectMeta,
     NodeAffinity,
     Operator,
     Pod,

@@ -236,9 +236,6 @@ class Cluster:
         # pod uid -> (node name decided, timestamp) from the last Solve
         self.pod_scheduling_decisions: dict[str, tuple[str, float]] = {}
         self._consolidated_at: float = -1.0
-        # names seen through the informers — the Synced() comparison set
-        self._seen_nodeclaims: set[str] = set()
-        self._seen_nodes: set[str] = set()
 
     # -- Synced barrier (cluster.go:118) ---------------------------------
 
@@ -304,7 +301,6 @@ class Cluster:
         sn = self._state_node_for(new_pid)
         sn.node_claim = claim
         self.claim_name_to_pid[claim.name] = new_pid
-        self._seen_nodeclaims.add(claim.name)
         self.mark_unconsolidated()
 
     def delete_nodeclaim(self, name: str) -> None:
@@ -337,7 +333,6 @@ class Cluster:
         sn = self._state_node_for(new_pid)
         sn.node = node
         self.node_name_to_pid[node.name] = new_pid
-        self._seen_nodes.add(node.name)
         # backfill pods bound to this node before it reached the cache (the
         # pod informer fired first): their requests were never tallied
         for uid, bound_node in self.bindings.items():
@@ -364,8 +359,10 @@ class Cluster:
 
     def update_pod(self, pod: Pod) -> None:
         uid = pod.uid
-        terminal = pod.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
-        gone = terminal or pod.metadata.deletion_timestamp is not None
+        # only TERMINAL pods release their node usage (cluster.go UpdatePod):
+        # a deleting-but-running pod still occupies capacity and still pins
+        # its anti-affinity domains until the delete event arrives
+        gone = pod.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
         old_node = self.bindings.get(uid)
         if old_node is not None and (gone or pod.node_name != old_node):
             self._unbind(uid, old_node)

@@ -54,14 +54,12 @@ class NodeTermination:
         cloud_provider,
         clock,
         recorder: Optional[Recorder] = None,
-        eviction_grace_seconds: float = 0.0,
     ):
         self.kube = kube
         self.cluster = cluster
         self.cloud = cloud_provider
         self.clock = clock
         self.recorder = recorder or Recorder(clock)
-        self.grace = eviction_grace_seconds
 
     def reconcile_all(self) -> None:
         for node in self.kube.list("Node"):
@@ -193,10 +191,7 @@ class NodeTermination:
                 pass
 
     def _claim_for(self, node: Node):
-        for claim in self.kube.list("NodeClaim"):
-            if (
-                claim.status.provider_id
-                and claim.status.provider_id == node.provider_id
-            ):
-                return claim
+        sn = self.cluster.node_by_name(node.name)
+        if sn is not None and sn.node_claim is not None:
+            return self.kube.try_get("NodeClaim", sn.node_claim.name)
         return None

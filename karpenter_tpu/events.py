@@ -24,7 +24,9 @@ class Event:
     dedupe_values: tuple = ()
 
     def dedupe_key(self) -> tuple:
-        return (self.kind, self.name, self.reason, *self.dedupe_values)
+        # the message participates so a NEW failure cause within the TTL is
+        # never swallowed; dedupe_values narrow the key further when set
+        return (self.kind, self.name, self.reason, self.message, *self.dedupe_values)
 
 
 class Recorder:

@@ -1,0 +1,293 @@
+"""Disruption subsystem: candidates, budgets, simulation, consolidation
+decisions (delete vs replace-with-cheaper), multi-node prefix search
+(batched sweep == binary search), emptiness, drift, validation, and the
+end-to-end consolidate loop through the operator.
+
+Reference behaviors: /root/reference/pkg/controllers/disruption/
+{consolidation,multinodeconsolidation,emptiness,drift,helpers}.go
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import (
+    COND_CONSOLIDATABLE,
+    COND_DRIFTED,
+    PodPhase,
+)
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.controllers.disruption import (
+    DECISION_DELETE,
+    DECISION_REPLACE,
+    MultiNodeConsolidation,
+    build_budget_mapping,
+    build_candidates,
+    simulate_scheduling,
+)
+from karpenter_tpu.controllers.kube import FakeClock
+from karpenter_tpu.controllers.operator import Operator
+from karpenter_tpu.testing import fixtures
+
+
+def settled_operator(n_pods=6, pod_kw=None, nodepool_kw=None):
+    """An operator with a provisioned, initialized cluster and RUNNING pods."""
+    op = Operator(clock=FakeClock(), force_oracle=True)
+    op.cloud.types = construct_instance_types(sizes=[2, 8, 32])
+    fixtures.reset_rng(21)
+    op.kube.create(
+        "NodePool", fixtures.node_pool(name="default", **(nodepool_kw or {}))
+    )
+    for i in range(n_pods):
+        kw = dict(requests={"cpu": "500m", "memory": "512Mi"})
+        kw.update(pod_kw or {})
+        op.kube.create("Pod", fixtures.pod(name=f"w-{i}", **kw))
+    assert op.run_until_settled(max_ticks=40) < 40
+    for p in op.kube.list("Pod"):
+        p.phase = PodPhase.RUNNING
+        op.kube.update("Pod", p)
+    return op
+
+
+def mark_consolidatable(op):
+    """Advance past the nomination window and consolidateAfter, then stamp
+    conditions."""
+    op.clock.advance(1.0)
+    op.pod_events.reconcile_all()
+    op.clock.advance(25.0)  # nomination window is 20s (statenode.go:431)
+    op.claim_conditions.reconcile_all()
+
+
+def test_candidates_and_gates():
+    op = settled_operator()
+    mark_consolidatable(op)
+    cands = build_candidates(
+        op.kube, op.cluster, op.cloud, op.clock, lambda c: True
+    )
+    assert cands, "initialized nodes should be candidates"
+    c = cands[0]
+    assert c.instance_type_name
+    assert c.price < 1e9
+    assert c.reschedulable_pods
+
+    # do-not-disrupt pod blocks its node
+    pod = c.reschedulable_pods[0]
+    stored = op.kube.get("Pod", pod.name)
+    stored.metadata.annotations[well_known.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+    op.kube.update("Pod", stored)
+    cands2 = build_candidates(
+        op.kube, op.cluster, op.cloud, op.clock, lambda c: True
+    )
+    assert c.name not in [x.name for x in cands2]
+
+
+def test_budget_mapping():
+    op = settled_operator()
+    n_nodes = len(op.kube.list("Node"))
+    budgets = build_budget_mapping(op.kube, op.cluster, "underutilized")
+    # default budget is 10% (rounded down) of the pool
+    assert budgets.allowed["default"] == max(0, int(n_nodes * 0.10))
+
+    np = op.kube.list("NodePool")[0]
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    budgets = build_budget_mapping(op.kube, op.cluster, "underutilized")
+    assert budgets.allowed["default"] == n_nodes
+
+
+def test_simulate_scheduling_excludes_candidates():
+    op = settled_operator()
+    mark_consolidatable(op)
+    cands = build_candidates(op.kube, op.cluster, op.cloud, op.clock, lambda c: True)
+    sim = simulate_scheduling(op.kube, op.cluster, op.cloud, cands, op.opts,
+                              force_oracle=True)
+    # removing every node means every reschedulable pod must be re-solved
+    total_resched = sum(len(c.reschedulable_pods) for c in cands)
+    assert len(sim.pods) == total_resched
+    assert sim.all_pods_scheduled()
+    # all candidate nodes excluded -> replacements must be new claims
+    assert sim.non_empty_new_claims()
+
+
+def test_emptiness_deletes_empty_nodes():
+    op = settled_operator(n_pods=2)
+    # delete the workload -> nodes become empty
+    for p in op.kube.list("Pod"):
+        op.kube.delete("Pod", p.name)
+    mark_consolidatable(op)
+    n_nodes = len(op.kube.list("Node"))
+    assert n_nodes >= 1
+    np = op.kube.list("NodePool")[0]
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+
+    # run the controller through poll + validation TTL
+    for _ in range(30):
+        op.step(2.0)
+        if not op.kube.list("Node") and not op.kube.list("NodeClaim"):
+            break
+    assert not op.kube.list("NodeClaim"), "empty claims should be consolidated away"
+    assert not op.kube.list("Node")
+
+
+def test_drift_replaces_drifted_node():
+    op = settled_operator(n_pods=3)
+    claims = op.kube.list("NodeClaim")
+    assert claims
+    # change the nodepool template -> hash drift
+    np = op.kube.list("NodePool")[0]
+    np.template.labels["fleet"] = "v2"
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    op.nodepool_hash.reconcile_all()
+    mark_consolidatable(op)
+    op.claim_conditions.reconcile_all()
+    drifted = [
+        c
+        for c in op.kube.list("NodeClaim")
+        if c.status.conditions.get(COND_DRIFTED) == "True"
+    ]
+    assert drifted, "hash change must mark claims drifted"
+
+    old_names = {c.name for c in claims}
+    for _ in range(40):
+        op.step(2.0)
+        current = {c.name for c in op.kube.list("NodeClaim")}
+        if current and not (current & old_names):
+            break
+    current = {c.name for c in op.kube.list("NodeClaim")}
+    assert current and not (current & old_names), "drifted claims replaced"
+    # new claims carry the new hash -> not drifted
+    for c in op.kube.list("NodeClaim"):
+        assert c.status.conditions.get(COND_DRIFTED) != "True"
+    # workload survived
+    assert all(p.node_name for p in op.kube.list("Pod"))
+
+
+def test_multi_node_consolidation_batched_equals_binary():
+    """The TPU-era prefix sweep and the reference's binary search must pick
+    the same (largest feasible) prefix."""
+    # many small pods spread over many small nodes; they all fit on one
+    # bigger replacement -> multi-node consolidation finds a big prefix
+    op = settled_operator(
+        n_pods=8, pod_kw=dict(requests={"cpu": "300m", "memory": "256Mi"})
+    )
+    mark_consolidatable(op)
+    np = op.kube.list("NodePool")[0]
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    kwargs = dict(options=op.opts, force_oracle=True)
+    batched = MultiNodeConsolidation(*args, sweep="batched", **kwargs)
+    binary = MultiNodeConsolidation(*args, sweep="binary", **kwargs)
+    cmd_a = batched.compute_commands()
+    cmd_b = binary.compute_commands()
+    names_a = sorted(c.name for cmd in cmd_a for c in cmd.candidates)
+    names_b = sorted(c.name for cmd in cmd_b for c in cmd.candidates)
+    assert names_a == names_b
+    if cmd_a:
+        assert cmd_a[0].decision == cmd_b[0].decision
+
+
+def test_consolidation_e2e_shrinks_cluster():
+    """Full loop: over-provisioned cluster consolidates down and every pod
+    survives on the remaining capacity."""
+    op = settled_operator(
+        n_pods=6, pod_kw=dict(requests={"cpu": "200m", "memory": "200Mi"})
+    )
+    np = op.kube.list("NodePool")[0]
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    n_before = len(op.kube.list("Node"))
+    cost_before = sum(
+        c.price
+        for c in build_candidates(op.kube, op.cluster, op.cloud, op.clock, lambda c: True)
+    )
+    mark_consolidatable(op)
+    for _ in range(60):
+        op.step(2.0)
+    n_after = len(op.kube.list("Node"))
+    assert n_after <= n_before
+    # every pod still bound somewhere real
+    node_names = {n.name for n in op.kube.list("Node")}
+    for p in op.kube.list("Pod"):
+        assert p.node_name in node_names
+
+
+def test_validation_vetoes_on_pod_churn():
+    op = settled_operator(n_pods=2)
+    for p in op.kube.list("Pod"):
+        op.kube.delete("Pod", p.name)
+    mark_consolidatable(op)
+    np = op.kube.list("NodePool")[0]
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    # let the controller pick an emptiness command (pending validation)
+    op.disruption.reconcile()
+    assert op.disruption._pending_validation is not None
+    # new pod lands on the node during the TTL -> validation must veto
+    p = fixtures.pod(name="intruder", requests={"cpu": "100m"})
+    op.kube.create("Pod", p)
+    node = op.kube.list("Node")[0]
+    op.kube.bind("intruder", node.name)
+    op.clock.advance(16.0)
+    op.disruption.reconcile()
+    assert op.kube.list("Node"), "validation should veto deleting a now-used node"
+
+
+def test_consolidatable_condition_lifecycle():
+    op = settled_operator(
+        n_pods=1, nodepool_kw=dict(consolidate_after_seconds=30.0)
+    )
+    claim = op.kube.list("NodeClaim")[0]
+    op.claim_conditions.reconcile_all()
+    claim = op.kube.get("NodeClaim", claim.name)
+    assert claim.status.conditions.get(COND_CONSOLIDATABLE) == "False"
+    op.clock.advance(31.0)
+    op.claim_conditions.reconcile_all()
+    claim = op.kube.get("NodeClaim", claim.name)
+    assert claim.status.conditions.get(COND_CONSOLIDATABLE) == "True"
+
+
+def test_expiration_controller():
+    op = settled_operator(n_pods=1)
+    claim = op.kube.list("NodeClaim")[0]
+    claim.expire_after_seconds = 60.0
+    claim.metadata.creation_timestamp = op.clock.now()
+    op.kube.update("NodeClaim", claim)
+    assert op.expiration.reconcile_all() == 0
+    op.clock.advance(61.0)
+    assert op.expiration.reconcile_all() == 1
+    # the deleted claim drains through termination; replacement comes up
+    op.run_until_settled(max_ticks=40)
+    assert all(p.node_name for p in op.kube.list("Pod"))
+
+
+def test_garbage_collection_both_directions():
+    op = settled_operator(n_pods=1)
+    claim = op.kube.list("NodeClaim")[0]
+    # direction 2: instance vanishes -> claim deleted
+    op.cloud.instances.pop(claim.status.provider_id)
+    orphans, lost = op.garbage_collection.reconcile()
+    assert (orphans, lost) == (0, 1)
+    # deletion initiated; the termination finalizer completes it
+    stored = op.kube.try_get("NodeClaim", claim.name)
+    assert stored is None or stored.metadata.deletion_timestamp is not None
+    for _ in range(10):
+        op.step(2.0)
+        if op.kube.try_get("NodeClaim", claim.name) is None:
+            break
+    assert op.kube.try_get("NodeClaim", claim.name) is None
+
+    # direction 1: orphan instance with no claim -> terminated
+    from karpenter_tpu.api.objects import NodeClaim, NodeClaimStatus
+
+    ghost = NodeClaim()
+    ghost.metadata.name = "ghost"
+    ghost.status = NodeClaimStatus(provider_id="kwok://ghost")
+    op.cloud.instances["kwok://ghost"] = ghost
+    orphans, lost = op.garbage_collection.reconcile()
+    assert orphans == 1
+    assert "kwok://ghost" not in op.cloud.instances
