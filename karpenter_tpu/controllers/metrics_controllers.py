@@ -1,0 +1,134 @@
+"""Cluster-observability metric controllers: per-node gauges, per-nodepool
+limits/usage, and pod lifecycle timings.
+
+Reference /root/reference/pkg/controllers/metrics/:
+- node/controller.go:176 (per-node allocatable/usage gauge families)
+- nodepool/controller.go:93 (limit gauges)
+- pod/controller.go:209-447 (pod state, scheduling-undecided/unbound
+  durations, startup time)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.api.objects import PodPhase
+from karpenter_tpu.controllers.state import Cluster, is_provisionable
+from karpenter_tpu import metrics
+
+NODE_ALLOCATABLE = metrics.REGISTRY.gauge(
+    "karpenter_nodes_allocatable",
+    "Node allocatable by resource type.",
+    ("node_name", "nodepool", "resource_type"),
+)
+NODE_USAGE = metrics.REGISTRY.gauge(
+    "karpenter_nodes_total_pod_requests",
+    "Total pod requests per node by resource type.",
+    ("node_name", "nodepool", "resource_type"),
+)
+NODEPOOL_LIMIT = metrics.REGISTRY.gauge(
+    "karpenter_nodepools_limit",
+    "NodePool resource limits.",
+    ("nodepool", "resource_type"),
+)
+POD_STATE = metrics.REGISTRY.gauge(
+    "karpenter_pods_current_state", "Pods by phase.", ("phase",)
+)
+POD_STARTUP = metrics.REGISTRY.histogram(
+    "karpenter_pods_startup_duration_seconds",
+    "Time from pod creation to running.",
+)
+POD_UNDECIDED = metrics.REGISTRY.gauge(
+    "karpenter_pods_scheduling_undecided", "Provisionable pods with no decision yet."
+)
+
+_node_store = metrics.Store(NODE_ALLOCATABLE)
+_usage_store = metrics.Store(NODE_USAGE)
+
+
+class NodeMetricsController:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def reconcile_all(self) -> None:
+        seen = set()
+        for sn in self.cluster.state_nodes():
+            if sn.node is None:
+                continue
+            seen.add(sn.name)
+            np_name = sn.nodepool_name or ""
+            _node_store.update(
+                sn.name,
+                [
+                    (
+                        {
+                            "node_name": sn.name,
+                            "nodepool": np_name,
+                            "resource_type": rname,
+                        },
+                        float(v),
+                    )
+                    for rname, v in sn.allocatable().items()
+                ],
+            )
+            _usage_store.update(
+                f"usage/{sn.name}",
+                [
+                    (
+                        {
+                            "node_name": sn.name,
+                            "nodepool": np_name,
+                            "resource_type": rname,
+                        },
+                        float(v),
+                    )
+                    for rname, v in sn.pods_requests_total().items()
+                ],
+            )
+        # GC series for vanished nodes
+        for key in list(_node_store._owned):
+            if key not in seen:
+                _node_store.delete(key)
+        for key in list(_usage_store._owned):
+            if key.startswith("usage/") and key[len("usage/"):] not in seen:
+                _usage_store.delete(key)
+
+
+class NodePoolMetricsController:
+    def __init__(self, kube):
+        self.kube = kube
+
+    def reconcile_all(self) -> None:
+        for np in self.kube.list("NodePool"):
+            for rname, v in np.limits.items():
+                NODEPOOL_LIMIT.set(
+                    float(v), {"nodepool": np.name, "resource_type": rname}
+                )
+
+
+class PodMetricsController:
+    def __init__(self, kube, cluster: Cluster, clock):
+        self.kube = kube
+        self.cluster = cluster
+        self.clock = clock
+        self._started: set[str] = set()
+
+    def reconcile_all(self) -> None:
+        counts: dict[str, int] = {}
+        undecided = 0
+        for pod in self.kube.list("Pod"):
+            counts[str(pod.phase.value)] = counts.get(str(pod.phase.value), 0) + 1
+            if is_provisionable(pod):
+                if pod.uid not in self.cluster.pod_scheduling_decisions:
+                    undecided += 1
+            if (
+                pod.phase == PodPhase.RUNNING
+                and pod.uid not in self._started
+            ):
+                self._started.add(pod.uid)
+                POD_STARTUP.observe(
+                    max(0.0, self.clock.now() - pod.metadata.creation_timestamp)
+                )
+        for phase, n in counts.items():
+            POD_STATE.set(float(n), {"phase": phase})
+        POD_UNDECIDED.set(float(undecided))

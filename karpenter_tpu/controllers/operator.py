@@ -12,9 +12,21 @@ from __future__ import annotations
 from typing import Optional
 
 from karpenter_tpu.api.objects import Pod
+from karpenter_tpu.cloudprovider.decorators import (
+    InstanceTypeStore,
+    MetricsCloudProvider,
+    OverlayCloudProvider,
+)
 from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
 from karpenter_tpu.controllers.disruption import DisruptionController
 from karpenter_tpu.controllers.kube import FakeClock, SimKube
+from karpenter_tpu.controllers.metrics_controllers import (
+    NodeMetricsController,
+    NodePoolMetricsController,
+    PodMetricsController,
+)
+from karpenter_tpu.controllers.nodeoverlay import NodeOverlayController
+from karpenter_tpu.controllers.static import StaticDeprovisioning, StaticProvisioning
 from karpenter_tpu.controllers.lifecycle import NodeClaimLifecycle
 from karpenter_tpu.controllers.nodeclaim_aux import (
     Consistency,
@@ -55,7 +67,14 @@ class Operator:
         self.cluster = Cluster(self.clock)
         wire_informers(self.kube, self.cluster)
         self.recorder = Recorder(self.clock)
-        self.cloud = cloud_provider or KwokCloudProvider(self.kube, self.clock)
+        raw_cloud = cloud_provider or KwokCloudProvider(self.kube, self.clock)
+        # decorator stack (kwok/main.go:31-38): overlay over metrics over raw
+        self.raw_cloud = raw_cloud
+        self.overlay_store = InstanceTypeStore()
+        decorated = MetricsCloudProvider(raw_cloud)
+        if self.opts.feature_gates.node_overlay:
+            decorated = OverlayCloudProvider(decorated, self.overlay_store)
+        self.cloud = decorated
         self.provisioner = Provisioner(
             self.kube,
             self.cluster,

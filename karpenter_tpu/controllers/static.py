@@ -1,0 +1,119 @@
+"""Static-capacity NodePools: maintain a fixed replica count of nodes,
+independent of pending pods (feature-gated, like the reference).
+
+Reference /root/reference/pkg/controllers/static/:
+- provisioning/controller.go:69-118 (scale up to spec.replicas)
+- deprovisioning/controller.go:75-240 (scale down, emptiest first)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import NodeClaim, NodePool, ObjectMeta
+from karpenter_tpu.controllers.kube import NotFound, SimKube
+from karpenter_tpu.controllers.state import Cluster
+from karpenter_tpu.events import Event, Recorder
+from karpenter_tpu.solver.nodes import NodeClaimTemplate
+from karpenter_tpu import metrics
+
+STATIC_NODES = metrics.REGISTRY.gauge(
+    "karpenter_static_nodepool_nodes",
+    "Nodes owned by static nodepools.",
+    ("nodepool",),
+)
+
+_static_seq = [0]
+
+
+class StaticProvisioning:
+    """Scale static pools up to replicas (provisioning/controller.go:69)."""
+
+    def __init__(self, kube: SimKube, cluster: Cluster, recorder: Optional[Recorder] = None):
+        self.kube = kube
+        self.cluster = cluster
+        self.recorder = recorder
+
+    def reconcile_all(self) -> int:
+        created = 0
+        for np in self.kube.list("NodePool"):
+            if np.replicas is None:
+                continue
+            owned = self._owned_claims(np.name)
+            STATIC_NODES.set(float(len(owned)), {"nodepool": np.name})
+            deficit = np.replicas - len(owned)
+            for _ in range(max(0, deficit)):
+                self._create_claim(np)
+                created += 1
+        return created
+
+    def _owned_claims(self, nodepool: str) -> list[NodeClaim]:
+        return [
+            c
+            for c in self.kube.list("NodeClaim")
+            if c.nodepool_name == nodepool
+            and c.metadata.deletion_timestamp is None
+        ]
+
+    def _create_claim(self, np: NodePool) -> None:
+        nct = NodeClaimTemplate(np)
+        nc = nct.to_node_claim(nct.requirements.copy(), [])
+        _static_seq[0] += 1
+        nc.metadata.name = f"{np.name}-static-{_static_seq[0]:05d}"
+        self.kube.create("NodeClaim", nc)
+        if self.recorder:
+            self.recorder.publish(
+                Event(
+                    "NodeClaim", nc.metadata.name, "Normal", "StaticProvisioned",
+                    f"maintaining {np.replicas} replicas",
+                )
+            )
+
+
+class StaticDeprovisioning:
+    """Scale static pools down to replicas, emptiest nodes first
+    (deprovisioning/controller.go:75)."""
+
+    def __init__(self, kube: SimKube, cluster: Cluster, recorder: Optional[Recorder] = None):
+        self.kube = kube
+        self.cluster = cluster
+        self.recorder = recorder
+
+    def reconcile_all(self) -> int:
+        deleted = 0
+        for np in self.kube.list("NodePool"):
+            if np.replicas is None:
+                continue
+            owned = [
+                c
+                for c in self.kube.list("NodeClaim")
+                if c.nodepool_name == np.name
+                and c.metadata.deletion_timestamp is None
+            ]
+            surplus = len(owned) - np.replicas
+            if surplus <= 0:
+                continue
+            # emptiest (fewest pods) first, newest as tiebreak
+            def pod_count(claim: NodeClaim) -> int:
+                name = claim.status.node_name
+                return len(self.cluster.pods_on(name)) if name else 0
+
+            owned.sort(
+                key=lambda c: (pod_count(c), -c.metadata.creation_timestamp)
+            )
+            for claim in owned[:surplus]:
+                try:
+                    self.kube.delete("NodeClaim", claim.name)
+                    deleted += 1
+                except NotFound:
+                    continue
+                if self.recorder:
+                    self.recorder.publish(
+                        Event(
+                            "NodeClaim", claim.name, "Normal",
+                            "StaticDeprovisioned",
+                            f"scaling down to {np.replicas} replicas",
+                        )
+                    )
+        return deleted

@@ -1,0 +1,272 @@
+"""The Solver service boundary: a sidecar process serving Solve over a
+unix-domain socket (SURVEY.md §7 M5; the reference's north star is a
+Go control plane reaching a TPU solver through cgo->gRPC — this is that
+boundary with the same framing discipline, minus the Go toolchain).
+
+Wire protocol (language-neutral; the C++ client in native/solver_client.cc
+speaks it too):
+
+    frame   := magic "KTPU" | u32 kind | u32 len | payload[len]
+    kind    := 1 SOLVE request   (payload = problem JSON, api/codec.py)
+               2 RESULT response (payload = result JSON + flat assignment
+                                  arrays base64'd in-header for small
+                                  problems; see _encode_result)
+               3 ERROR response  (payload = utf-8 message)
+               4 PING / 5 PONG   (health)
+    u32     := little-endian
+
+Timeout/cancellation follows provisioner.go:366-374: the request carries
+`timeout_seconds`; the server passes it into SchedulerOptions so a Solve
+that overruns returns partial results with timed_out=True instead of
+hanging the control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from typing import Optional
+
+from karpenter_tpu.api import codec
+from karpenter_tpu.solver.hybrid import HybridScheduler
+from karpenter_tpu.solver.oracle import SchedulerOptions
+from karpenter_tpu.solver.topology import Topology
+
+MAGIC = b"KTPU"
+KIND_SOLVE = 1
+KIND_RESULT = 2
+KIND_ERROR = 3
+KIND_PING = 4
+KIND_PONG = 5
+
+
+def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
+    sock.sendall(MAGIC + struct.pack("<II", kind, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        if not got:
+            raise ConnectionError("peer closed")
+        buf += got
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> tuple[int, bytes]:
+    head = _recv_exact(sock, 12)
+    if head[:4] != MAGIC:
+        raise ValueError(f"bad magic {head[:4]!r}")
+    kind, length = struct.unpack("<II", head[4:])
+    return kind, _recv_exact(sock, length)
+
+
+# ---------------------------------------------------------------------------
+# problem wire form
+
+
+def encode_problem_request(
+    node_pools,
+    instance_types_by_pool,
+    pods,
+    state_node_views=None,
+    daemonset_pods=None,
+    options: Optional[SchedulerOptions] = None,
+    force_oracle: bool = False,
+) -> bytes:
+    req = {
+        "node_pools": codec.to_jsonable(node_pools),
+        "instance_types_by_pool": {
+            k: codec.to_jsonable(list(v)) for k, v in instance_types_by_pool.items()
+        },
+        "pods": codec.to_jsonable(pods),
+        "state_node_views": None,  # views carry live handles; service solves fresh
+        "daemonset_pods": codec.to_jsonable(daemonset_pods or []),
+        "options": {
+            "ignore_preferences": bool(options and options.ignore_preferences),
+            "min_values_best_effort": bool(options and options.min_values_best_effort),
+            "timeout_seconds": options.timeout_seconds if options else None,
+        },
+        "force_oracle": force_oracle,
+    }
+    return json.dumps(req).encode()
+
+
+def _decode_problem_request(payload: bytes):
+    req = json.loads(payload)
+    node_pools = codec.from_jsonable(req["node_pools"])
+    its_by_pool = {
+        k: codec.from_jsonable(v) for k, v in req["instance_types_by_pool"].items()
+    }
+    pods = codec.from_jsonable(req["pods"])
+    daemons = codec.from_jsonable(req.get("daemonset_pods") or [])
+    o = req.get("options") or {}
+    options = SchedulerOptions(
+        ignore_preferences=o.get("ignore_preferences", False),
+        min_values_best_effort=o.get("min_values_best_effort", False),
+        timeout_seconds=o.get("timeout_seconds"),
+    )
+    return node_pools, its_by_pool, pods, daemons, options, req.get("force_oracle", False)
+
+
+def _encode_result(results, used_tpu: bool) -> bytes:
+    claims = []
+    for c in results.new_node_claims:
+        claims.append(
+            {
+                "nodepool": c.nodepool_name,
+                "pod_uids": [p.uid for p in c.pods],
+                "instance_types": [it.name for it in c.instance_type_options],
+                "requests": dict(c.requests),
+            }
+        )
+    out = {
+        "used_tpu": used_tpu,
+        "timed_out": results.timed_out,
+        "pod_errors": dict(results.pod_errors),
+        "new_node_claims": claims,
+        "existing_assignments": {
+            p.uid: n.name for n in results.existing_nodes for p in n.pods
+        },
+    }
+    return json.dumps(out).encode()
+
+
+# ---------------------------------------------------------------------------
+# server
+
+
+class SolverServer:
+    """Serves SOLVE frames; one connection at a time (the control plane is a
+    singleton provisioner — matching the reference's concurrency model)."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.solves = 0
+
+    def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.socket_path)
+        self._sock.listen(4)
+        self._sock.settimeout(0.2)
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._sock is not None:
+            self._sock.close()
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                self._handle(conn)
+            except (ConnectionError, ValueError):
+                pass
+            finally:
+                conn.close()
+
+    def _handle(self, conn: socket.socket) -> None:
+        while not self._stop.is_set():
+            kind, payload = _recv_frame(conn)
+            if kind == KIND_PING:
+                _send_frame(conn, KIND_PONG, b"")
+                continue
+            if kind != KIND_SOLVE:
+                _send_frame(conn, KIND_ERROR, f"unknown kind {kind}".encode())
+                continue
+            try:
+                _send_frame(conn, KIND_RESULT, self._solve(payload))
+            except Exception as e:  # error frames, never a dead socket
+                _send_frame(conn, KIND_ERROR, str(e).encode())
+
+    def _solve(self, payload: bytes) -> bytes:
+        (
+            node_pools,
+            its_by_pool,
+            pods,
+            daemons,
+            options,
+            force_oracle,
+        ) = _decode_problem_request(payload)
+        topology = Topology(node_pools, its_by_pool, pods)
+        scheduler = HybridScheduler(
+            node_pools,
+            its_by_pool,
+            topology,
+            None,
+            daemons,
+            options,
+            force_oracle=force_oracle,
+        )
+        results = scheduler.solve(pods)
+        self.solves += 1
+        return _encode_result(results, bool(scheduler.used_tpu))
+
+
+# ---------------------------------------------------------------------------
+# client
+
+
+class SolverClient:
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._sock: Optional[socket.socket] = None
+
+    def connect(self, timeout: float = 5.0) -> None:
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(self.socket_path)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def ping(self) -> bool:
+        _send_frame(self._sock, KIND_PING, b"")
+        kind, _ = _recv_frame(self._sock)
+        return kind == KIND_PONG
+
+    def solve(
+        self,
+        node_pools,
+        instance_types_by_pool,
+        pods,
+        daemonset_pods=None,
+        options: Optional[SchedulerOptions] = None,
+        force_oracle: bool = False,
+    ) -> dict:
+        payload = encode_problem_request(
+            node_pools,
+            instance_types_by_pool,
+            pods,
+            None,
+            daemonset_pods,
+            options,
+            force_oracle,
+        )
+        _send_frame(self._sock, KIND_SOLVE, payload)
+        kind, resp = _recv_frame(self._sock)
+        if kind == KIND_ERROR:
+            raise RuntimeError(resp.decode())
+        return json.loads(resp)

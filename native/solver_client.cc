@@ -1,0 +1,147 @@
+// Native solver-service client: the cgo-shim equivalent of the reference's
+// planned Go -> sidecar boundary (SURVEY.md §7 M5 / §2.8 item 4).
+//
+// Speaks the KTPU frame protocol of karpenter_tpu/solver/service.py over a
+// unix-domain socket:
+//   frame := "KTPU" | u32le kind | u32le len | payload[len]
+//   kinds: 1=SOLVE 2=RESULT 3=ERROR 4=PING 5=PONG
+//
+// Usage:
+//   solver_client <socket-path> ping
+//   solver_client <socket-path> solve < problem.json   (prints the RESULT
+//                                                       payload to stdout)
+//
+// A control plane embedding this as a library would link solve_request();
+// the main() is the conformance harness the Python test drives.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[4] = {'K', 'T', 'P', 'U'};
+constexpr uint32_t kSolve = 1;
+constexpr uint32_t kResult = 2;
+constexpr uint32_t kError = 3;
+constexpr uint32_t kPing = 4;
+constexpr uint32_t kPong = 5;
+
+bool send_all(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_frame(int fd, uint32_t kind, const std::string& payload) {
+  char head[12];
+  std::memcpy(head, kMagic, 4);
+  uint32_t k = kind, len = static_cast<uint32_t>(payload.size());
+  std::memcpy(head + 4, &k, 4);   // little-endian hosts only (x86/arm LE)
+  std::memcpy(head + 8, &len, 4);
+  if (!send_all(fd, head, sizeof head)) return false;
+  return payload.empty() || send_all(fd, payload.data(), payload.size());
+}
+
+bool recv_frame(int fd, uint32_t* kind, std::string* payload) {
+  char head[12];
+  if (!recv_all(fd, head, sizeof head)) return false;
+  if (std::memcmp(head, kMagic, 4) != 0) return false;
+  uint32_t len;
+  std::memcpy(kind, head + 4, 4);
+  std::memcpy(&len, head + 8, 4);
+  payload->resize(len);
+  return len == 0 || recv_all(fd, payload->data(), len);
+}
+
+int connect_unix(const char* path) {
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::snprintf(addr.sun_path, sizeof addr.sun_path, "%s", path);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+// The embeddable API: returns 0 and fills *result on success; 1 on a
+// solver-side ERROR frame (message in *result); negative on transport error.
+int solve_request(int fd, const std::string& problem_json, std::string* result) {
+  if (!send_frame(fd, kSolve, problem_json)) return -2;
+  uint32_t kind = 0;
+  if (!recv_frame(fd, &kind, result)) return -3;
+  if (kind == kError) return 1;
+  if (kind != kResult) return -4;
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <socket> ping|solve\n", argv[0]);
+    return 64;
+  }
+  int fd = connect_unix(argv[1]);
+  if (fd < 0) {
+    std::fprintf(stderr, "connect failed: %s\n", argv[1]);
+    return 1;
+  }
+  const std::string cmd = argv[2];
+  int rc = 0;
+  if (cmd == "ping") {
+    std::string payload;
+    uint32_t kind = 0;
+    if (!send_frame(fd, kPing, "") || !recv_frame(fd, &kind, &payload) ||
+        kind != kPong) {
+      std::fprintf(stderr, "ping failed\n");
+      rc = 1;
+    } else {
+      std::printf("pong\n");
+    }
+  } else if (cmd == "solve") {
+    std::string problem, chunk(1 << 16, '\0');
+    size_t r;
+    while ((r = std::fread(chunk.data(), 1, chunk.size(), stdin)) > 0)
+      problem.append(chunk, 0, r);
+    std::string result;
+    int got = solve_request(fd, problem, &result);
+    if (got == 0) {
+      std::fwrite(result.data(), 1, result.size(), stdout);
+      std::printf("\n");
+    } else {
+      std::fprintf(stderr, "solve failed (%d): %s\n", got, result.c_str());
+      rc = 1;
+    }
+  } else {
+    std::fprintf(stderr, "unknown command %s\n", cmd.c_str());
+    rc = 64;
+  }
+  ::close(fd);
+  return rc;
+}

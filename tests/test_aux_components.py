@@ -1,0 +1,212 @@
+"""Decorators, NodeOverlay, static capacity, metrics controllers, options,
+events, and the metrics registry."""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu import metrics
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import NodeSelectorRequirement, ObjectMeta, Operator
+from karpenter_tpu.cloudprovider.decorators import (
+    SPI_DURATION,
+    InstanceTypeStore,
+    MetricsCloudProvider,
+    OverlayCloudProvider,
+)
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.controllers.kube import FakeClock
+from karpenter_tpu.controllers.nodeoverlay import NodeOverlay, NodeOverlayController
+from karpenter_tpu.controllers.operator import Operator as Op
+from karpenter_tpu.events import Event, Recorder
+from karpenter_tpu.options import FeatureGates, Options
+from karpenter_tpu.testing import fixtures
+
+
+def small_op(**kw):
+    op = Op(clock=FakeClock(), force_oracle=True, **kw)
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 8])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    return op
+
+
+def test_metrics_decorator_times_calls():
+    op = small_op()
+    before = SPI_DURATION.count(
+        {"controller": "", "method": "get_instance_types", "provider": "kwok"}
+    )
+    np_ = fixtures.node_pool(name="default")
+    op.cloud.get_instance_types(np_)
+    after = SPI_DURATION.count(
+        {"controller": "", "method": "get_instance_types", "provider": "kwok"}
+    )
+    assert after == before + 1
+
+
+def test_overlay_price_adjustment():
+    op = small_op()
+    np_ = fixtures.node_pool(name="default")
+    op.kube.create("NodePool", np_)
+    store = InstanceTypeStore()
+    overlay_cloud = OverlayCloudProvider(op.cloud, store)
+    ctrl = NodeOverlayController(op.kube, op.cloud, store)
+
+    ov = NodeOverlay(
+        metadata=ObjectMeta(name="spot-discount"),
+        requirements=[
+            NodeSelectorRequirement(
+                "karpenter.kwok.sh/instance-family", Operator.IN, ["c"]
+            )
+        ],
+        price_adjustment="-50%",
+    )
+    op.kube.create("NodeOverlay", ov)
+    assert ctrl.reconcile_all() == {}
+
+    base = {it.name: it for it in op.cloud.get_instance_types(np_)}
+    patched = {it.name: it for it in overlay_cloud.get_instance_types(np_)}
+    for name, it in patched.items():
+        if name.startswith("c-"):
+            assert it.offerings[0].price == pytest.approx(
+                base[name].offerings[0].price * 0.5
+            )
+        else:
+            assert it.offerings[0].price == base[name].offerings[0].price
+
+
+def test_overlay_capacity_patch_and_validation():
+    op = small_op()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    store = InstanceTypeStore()
+    ctrl = NodeOverlayController(op.kube, op.cloud, store)
+
+    good = NodeOverlay(
+        metadata=ObjectMeta(name="add-gpu"),
+        requirements=[],
+        capacity={"example.com/gpu": 4000},
+    )
+    bad = NodeOverlay(
+        metadata=ObjectMeta(name="conflicted"),
+        price=1.0,
+        price_adjustment="+10%",
+    )
+    op.kube.create("NodeOverlay", good)
+    op.kube.create("NodeOverlay", bad)
+    problems = ctrl.reconcile_all()
+    assert "conflicted" in problems
+    patched = store.get("default")
+    assert all(it.capacity.get("example.com/gpu") == 4000 for it in patched)
+
+
+def test_static_provisioning_and_deprovisioning():
+    gates = FeatureGates(static_capacity=True)
+    op = small_op(options=Options(feature_gates=gates))
+    from karpenter_tpu.controllers.static import (
+        StaticDeprovisioning,
+        StaticProvisioning,
+    )
+
+    np_ = fixtures.node_pool(name="warmpool", replicas=3)
+    op.kube.create("NodePool", np_)
+    prov = StaticProvisioning(op.kube, op.cluster, op.recorder)
+    deprov = StaticDeprovisioning(op.kube, op.cluster, op.recorder)
+
+    assert prov.reconcile_all() == 3
+    assert prov.reconcile_all() == 0  # idempotent
+    assert len(op.kube.list("NodeClaim")) == 3
+    op.run_until_settled(max_ticks=30)
+    assert len(op.kube.list("Node")) == 3
+
+    # scale down
+    np_ = op.kube.list("NodePool")[0]
+    np_.replicas = 1
+    op.kube.update("NodePool", np_)
+    assert deprov.reconcile_all() == 2
+    for _ in range(12):
+        op.step(2.0)
+    assert len(op.kube.list("Node")) == 1
+    assert prov.reconcile_all() == 0
+
+
+def test_node_and_pod_metrics_controllers():
+    from karpenter_tpu.controllers.metrics_controllers import (
+        NODE_ALLOCATABLE,
+        POD_STATE,
+        NodeMetricsController,
+        NodePoolMetricsController,
+        PodMetricsController,
+    )
+
+    op = small_op()
+    fixtures.reset_rng(3)
+    op.kube.create("NodePool", fixtures.node_pool(name="default", limits={"cpu": "100"}))
+    for p in fixtures.make_generic_pods(3):
+        op.kube.create("Pod", p)
+    op.run_until_settled(max_ticks=30)
+
+    NodeMetricsController(op.cluster).reconcile_all()
+    NodePoolMetricsController(op.kube).reconcile_all()
+    PodMetricsController(op.kube, op.cluster, op.clock).reconcile_all()
+
+    node = op.kube.list("Node")[0]
+    got = NODE_ALLOCATABLE.value(
+        {"node_name": node.name, "nodepool": "default", "resource_type": "cpu"}
+    )
+    assert got > 0
+    assert POD_STATE.value({"phase": "Pending"}) >= 0
+
+    # metric GC: node vanishes -> series vanish
+    claim = op.kube.list("NodeClaim")[0]
+    op.kube.delete("NodeClaim", claim.name)
+    for _ in range(12):
+        op.step(2.0)
+    NodeMetricsController(op.cluster).reconcile_all()
+    assert (
+        NODE_ALLOCATABLE.value(
+            {"node_name": node.name, "nodepool": "default", "resource_type": "cpu"}
+        )
+        == 0.0
+    )
+
+
+def test_options_env_and_gates():
+    opts = Options.from_env(
+        {
+            "KARPENTER_BATCH_IDLE_DURATION": "2.5",
+            "KARPENTER_PREFERENCE_POLICY": "Ignore",
+            "KARPENTER_FEATURE_GATES": "SpotToSpotConsolidation,NodeRepair=true,NodeOverlay=false",
+        }
+    )
+    assert opts.batch_idle_duration_seconds == 2.5
+    assert opts.preference_policy == "Ignore"
+    assert opts.feature_gates.spot_to_spot_consolidation
+    assert opts.feature_gates.node_repair
+    assert not opts.feature_gates.node_overlay
+
+
+def test_recorder_dedupe():
+    clock = FakeClock()
+    r = Recorder(clock)
+    e = Event("Pod", "p1", "Warning", "FailedScheduling", "no capacity")
+    r.publish(e)
+    r.publish(e)
+    assert len(r.events) == 1
+    # different message is a different cause -> published
+    r.publish(Event("Pod", "p1", "Warning", "FailedScheduling", "taint mismatch"))
+    assert len(r.events) == 2
+    clock.advance(121.0)
+    r.publish(e)
+    assert len(r.events) == 3
+
+
+def test_metrics_render_exposition():
+    reg = metrics.Registry()
+    c = reg.counter("karpenter_test_total", "help text", ("kind",))
+    c.inc({"kind": "a"})
+    h = reg.histogram("karpenter_test_seconds", "help", buckets=[0.1, 1.0])
+    h.observe(0.5)
+    h.observe(5.0)  # above the last bucket -> only +Inf
+    text = reg.render()
+    assert 'karpenter_test_total{kind="a"} 1.0' in text
+    assert 'le="+Inf"} 2' in text
+    assert "karpenter_test_seconds_count 2" in text

@@ -31,8 +31,8 @@ from karpenter_tpu.testing import fixtures
 def small_operator(**kw) -> Operator:
     clock = FakeClock()
     op = Operator(clock=clock, force_oracle=kw.pop("force_oracle", True), **kw)
-    op.cloud.types = construct_instance_types(sizes=[2, 8, 32])
-    op.cloud._by_name = {it.name: it.name and it for it in op.cloud.types}
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 8, 32])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
     return op
 
 

@@ -34,7 +34,8 @@ from karpenter_tpu.testing import fixtures
 def settled_operator(n_pods=6, pod_kw=None, nodepool_kw=None):
     """An operator with a provisioned, initialized cluster and RUNNING pods."""
     op = Operator(clock=FakeClock(), force_oracle=True)
-    op.cloud.types = construct_instance_types(sizes=[2, 8, 32])
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 8, 32])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
     fixtures.reset_rng(21)
     op.kube.create(
         "NodePool", fixtures.node_pool(name="default", **(nodepool_kw or {}))
