@@ -72,6 +72,8 @@ class ConsolidationBase:
     def should_disrupt(self, c: Candidate) -> bool:
         """consolidation.go:89 ShouldDisrupt: nodepool allows consolidation
         and the claim's Consolidatable condition is True."""
+        if c.owned_by_static_nodepool():  # consolidation.go:91
+            return False
         policy = c.node_pool.disruption.consolidation_policy
         if policy == "WhenEmpty" and not c.is_empty():
             return False
@@ -163,6 +165,8 @@ class EmptinessConsolidation(ConsolidationBase):
     reason = REASON_EMPTY
 
     def should_disrupt(self, c: Candidate) -> bool:
+        if c.owned_by_static_nodepool():  # emptiness.go:43
+            return False
         return c.is_empty() and c.consolidatable()
 
     def compute_commands(self) -> list[Command]:
@@ -187,7 +191,7 @@ class DriftConsolidation(ConsolidationBase):
     reason = REASON_DRIFTED
 
     def should_disrupt(self, c: Candidate) -> bool:
-        return c.drifted()
+        return not c.owned_by_static_nodepool() and c.drifted()  # drift.go:56
 
     def compute_commands(self) -> list[Command]:
         candidates = self.candidates()

@@ -20,6 +20,7 @@ from karpenter_tpu.controllers.disruption.queue import (
     OrchestrationQueue,
     Validator,
 )
+from karpenter_tpu.controllers.disruption.staticdrift import StaticDrift
 from karpenter_tpu.controllers.disruption.types import Command
 from karpenter_tpu.controllers.state import DISRUPTED_TAINT
 from karpenter_tpu.events import Recorder
@@ -62,6 +63,7 @@ class DisruptionController:
         # NewMethods order (controller.go:98)
         self.methods = [
             EmptinessConsolidation(*args, **kwargs),
+            StaticDrift(*args, **kwargs),
             DriftConsolidation(*args, **kwargs),
             MultiNodeConsolidation(*args, **kwargs),
             SingleNodeConsolidation(*args, **kwargs),
@@ -87,6 +89,7 @@ class DisruptionController:
             if self.validator.validate(cmd):
                 self.queue.start_command(cmd)
                 return cmd
+            self._release_reservation(cmd)
             return None
         if now - self._last_run < self.opts.disruption_poll_seconds:
             return None
@@ -104,15 +107,23 @@ class DisruptionController:
             if not commands:
                 continue
             cmd = commands[0]
-            if isinstance(method, EmptinessConsolidation):
-                # emptiness validates after a shorter wait but same machinery
-                self._pending_validation = (now, cmd)
-            else:
-                self._pending_validation = (now, cmd)
+            # this controller serializes one command at a time; any node-
+            # count reservations held by the commands it won't execute must
+            # be handed back (the next reconcile re-reserves)
+            for other in commands[1:]:
+                self._release_reservation(other)
+            self._pending_validation = (now, cmd)
             return None
         # nothing to do: the cluster is consolidated (cluster.go:550)
         self.cluster.mark_consolidated()
         return None
+
+    def _release_reservation(self, cmd: Command) -> None:
+        if cmd.reserved_pool and cmd.reserved_count > 0:
+            self.cluster.nodepool_state.release_node_count(
+                cmd.reserved_pool, cmd.reserved_count
+            )
+            cmd.reserved_count = 0
 
     def _clean_stale_taints(self) -> None:
         """controller.go:143: nodes tainted for disruption but no longer

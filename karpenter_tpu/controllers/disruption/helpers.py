@@ -251,7 +251,9 @@ def build_budget_mapping(kube, cluster: Cluster, reason: str) -> BudgetMapping:
                 continue
             raw = budget.nodes.strip()
             if raw.endswith("%"):
-                limit = math.floor(total * float(raw[:-1]) / 100.0)
+                # nodepool.go:359 GetScaledValueFromIntOrPercent(roundUp=true):
+                # a 10% budget on a 5-node pool still allows 1 disruption
+                limit = math.ceil(total * float(raw[:-1]) / 100.0)
             else:
                 limit = int(raw)
             allowed = min(allowed, limit)

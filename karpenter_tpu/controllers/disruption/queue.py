@@ -20,7 +20,7 @@ from karpenter_tpu.controllers.disruption.types import (
     Command,
 )
 from karpenter_tpu.controllers.kube import NotFound
-from karpenter_tpu.controllers.state import DISRUPTED_TAINT
+from karpenter_tpu.controllers.state import DISRUPTED_TAINT, is_reschedulable
 from karpenter_tpu.events import Event
 from karpenter_tpu import metrics
 
@@ -58,14 +58,21 @@ class Validator:
                 return False
             if sn.nominated(self.clock.now()):
                 return False  # the provisioner wants this node
+        if all(c.owned_by_static_nodepool() for c in cmd.candidates):
+            # StaticDrift is an eventual-class method: its replacement is a
+            # workload-independent template launch, so the consolidation
+            # re-simulation (which excludes static pools, helpers.py:75)
+            # must not veto it — the reference never validates it
+            # (controller.go dispatches validation per method class)
+            return True
         if cmd.decision == DECISION_DELETE and all(
             c.is_empty() for c in cmd.candidates
         ):
-            # emptiness validation: still empty?
+            # emptiness validation: still empty of *reschedulable* pods
+            # (emptiness.go:67 — daemonsets/terminal pods don't count)
             for c in cmd.candidates:
                 if any(
-                    True
-                    for p in self.cluster.pods_on(c.name)
+                    is_reschedulable(p) for p in self.cluster.pods_on(c.name)
                 ):
                     return False
             return True
@@ -112,6 +119,14 @@ class OrchestrationQueue:
         replacements."""
         names = [c.name for c in cmd.candidates]
         self.cluster.mark_for_deletion(*names)
+        # queue.go:279: static candidates become pending-disruption (their
+        # replacement is being created; StaticProvisioning must not race)
+        for c in cmd.candidates:
+            claim_name = c.claim_name()
+            if c.owned_by_static_nodepool() and claim_name is not None:
+                self.cluster.nodepool_state.mark_pending_disruption(
+                    c.nodepool_name, claim_name
+                )
         for c in cmd.candidates:
             node = self.kube.try_get("Node", c.name)
             if node is not None and DISRUPTED_TAINT not in node.taints:
@@ -122,15 +137,30 @@ class OrchestrationQueue:
                     pass
         item = _InFlight(command=cmd)
         if cmd.replacements:
+            from karpenter_tpu.api.objects import NodeClaim as ApiNodeClaim
             from karpenter_tpu.solver.oracle import Results
 
-            fake_results = Results(
-                new_node_claims=cmd.replacements,
-                existing_nodes=[],
-                pod_errors={},
-            )
-            created = self.provisioner.create_node_claims(fake_results)
-            item.replacement_names = [c.name for c in created]
+            bare = [r for r in cmd.replacements if isinstance(r, ApiNodeClaim)]
+            solved = [r for r in cmd.replacements if not isinstance(r, ApiNodeClaim)]
+            # StaticDrift replacements are bare template launches with no
+            # pods (staticdrift.go:95) — create them directly and convert
+            # their node-count reservation (provisioner.go:166)
+            for nc in bare:
+                stored = self.kube.create("NodeClaim", nc)
+                item.replacement_names.append(stored.name)
+                pool = stored.nodepool_name
+                if pool:
+                    # launch converts the reservation to an active claim
+                    self.cluster.nodepool_state.release_node_count(pool, 1)
+                    cmd.reserved_count = max(0, cmd.reserved_count - 1)
+            if solved:
+                fake_results = Results(
+                    new_node_claims=solved,
+                    existing_nodes=[],
+                    pod_errors={},
+                )
+                created = self.provisioner.create_node_claims(fake_results)
+                item.replacement_names += [c.name for c in created]
         item.launched = True
         self.in_flight.append(item)
         COMMANDS_EXECUTED.inc(

@@ -54,6 +54,11 @@ class Candidate:
     def is_empty(self) -> bool:
         return not self.reschedulable_pods
 
+    def owned_by_static_nodepool(self) -> bool:
+        """types.go:83: static pools scale via their replica controllers;
+        only StaticDrift may disrupt them."""
+        return self.node_pool.replicas is not None
+
     def condition(self, cond: str) -> bool:
         claim = self.state_node.node_claim
         return claim is not None and claim.status.conditions.get(cond) == "True"
@@ -80,6 +85,11 @@ class Command:
     reason: str
     candidates: list[Candidate] = field(default_factory=list)
     replacements: list[SchedulingNodeClaim] = field(default_factory=list)
+    # node-count reservations held against a static pool's `nodes` limit
+    # (statenodepool.go ReserveNodeCount); released on launch — or by the
+    # controller if the command is discarded or fails validation
+    reserved_pool: Optional[str] = None
+    reserved_count: int = 0
 
     @property
     def decision(self) -> str:

@@ -120,6 +120,30 @@ class Operator:
             if self.opts.feature_gates.node_repair
             else None
         )
+        # static-capacity pools provision via their own loop (controllers.go:139
+        # gate; provisioning.py excludes replicas!=None pools for this reason)
+        self.static_provisioning = (
+            StaticProvisioning(self.kube, self.cluster, self.recorder)
+            if self.opts.feature_gates.static_capacity
+            else None
+        )
+        self.static_deprovisioning = (
+            StaticDeprovisioning(self.kube, self.cluster, self.recorder)
+            if self.opts.feature_gates.static_capacity
+            else None
+        )
+        self.node_overlay = (
+            NodeOverlayController(self.kube, self.raw_cloud, self.overlay_store)
+            if self.opts.feature_gates.node_overlay
+            else None
+        )
+        self.node_metrics = NodeMetricsController(self.cluster)
+        self.nodepool_metrics = NodePoolMetricsController(self.kube)
+        self.pod_metrics = PodMetricsController(self.kube, self.cluster, self.clock)
+        # pure observability: poll on an interval like the reference's
+        # metrics controllers, not every reconcile round
+        self._metrics_interval = 10.0
+        self._metrics_last = -1e18
 
         # trigger controllers (provisioning/controller.go:44): watch events
         def triggers(event: str, kind: str, obj) -> None:
@@ -157,6 +181,17 @@ class Operator:
         self.consistency.reconcile_all()
         if self.node_health is not None:
             self.node_health.reconcile_all()
+        if self.node_overlay is not None:
+            self.node_overlay.reconcile_all()
+        if self.static_provisioning is not None:
+            self.static_provisioning.reconcile_all()
+        if self.static_deprovisioning is not None:
+            self.static_deprovisioning.reconcile_all()
+        if self.clock.now() - self._metrics_last >= self._metrics_interval:
+            self._metrics_last = self.clock.now()
+            self.node_metrics.reconcile_all()
+            self.nodepool_metrics.reconcile_all()
+            self.pod_metrics.reconcile_all()
         # the pod trigger controller requeues provisionable pods continuously
         # (provisioning/controller.go:60); without it a pod that failed or
         # awaits a node would never reopen the batch window

@@ -220,6 +220,94 @@ class StateNode:
         )
 
 
+class NodePoolState:
+    """statenodepool.go: per-pool active / deleting / pending-disruption
+    NodeClaim name sets plus node-count reservations. The reservation path
+    lets static provisioning and StaticDrift scale decisions coordinate
+    against a pool's `nodes` limit without bursting over it
+    (statenodepool.go:137 ReserveNodeCount)."""
+
+    def __init__(self) -> None:
+        self._pools: dict[str, dict[str, set[str]]] = {}
+        self._claim_to_pool: dict[str, str] = {}
+        self._reserved: dict[str, int] = {}
+
+    def _entry(self, pool: str) -> dict[str, set[str]]:
+        e = self._pools.get(pool)
+        if e is None:
+            e = {"active": set(), "deleting": set(), "pending": set()}
+            self._pools[pool] = e
+            self._reserved.setdefault(pool, 0)
+        return e
+
+    def mark_active(self, pool: str, claim: str) -> None:
+        e = self._entry(pool)
+        e["pending"].discard(claim)
+        e["deleting"].discard(claim)
+        e["active"].add(claim)
+        self._claim_to_pool[claim] = pool
+
+    def mark_deleting(self, pool: str, claim: str) -> None:
+        e = self._entry(pool)
+        e["pending"].discard(claim)
+        e["active"].discard(claim)
+        e["deleting"].add(claim)
+        self._claim_to_pool[claim] = pool
+
+    def mark_pending_disruption(self, pool: str, claim: str) -> None:
+        e = self._entry(pool)
+        e["active"].discard(claim)
+        e["deleting"].discard(claim)
+        e["pending"].add(claim)
+        self._claim_to_pool[claim] = pool
+
+    def cleanup(self, claim: str) -> None:
+        """statenodepool.go:106: drop the claim; drop the pool entry once
+        nothing active or deleting remains."""
+        pool = self._claim_to_pool.pop(claim, None)
+        if pool is None:
+            return
+        e = self._pools.get(pool)
+        if e is None:
+            return
+        for s in e.values():
+            s.discard(claim)
+        if not e["active"] and not e["deleting"]:
+            self._pools.pop(pool, None)
+            self._reserved.pop(pool, None)
+
+    def node_counts(self, pool: str) -> tuple[int, int, int]:
+        """(active, deleting, pending_disruption)"""
+        e = self._pools.get(pool)
+        if e is None:
+            return 0, 0, 0
+        return len(e["active"]), len(e["deleting"]), len(e["pending"])
+
+    def reserve_node_count(self, pool: str, limit: float, wanted: int) -> int:
+        """Grant up to `wanted` new-node reservations without active +
+        deleting + pending + reserved exceeding `limit`."""
+        self._entry(pool)
+        a, d, p = self.node_counts(pool)
+        remaining = limit - (a + d + p) - self._reserved[pool]
+        if remaining < 0:
+            return 0
+        granted = int(min(wanted, remaining))
+        self._reserved[pool] += max(0, granted)
+        return max(0, granted)
+
+    def release_node_count(self, pool: str, count: int = 1) -> None:
+        self._reserved[pool] = max(0, self._reserved.get(pool, 0) - count)
+
+    def update_node_claim(self, claim: NodeClaim, marked_for_deletion: bool) -> None:
+        pool = claim.nodepool_name
+        if not pool:
+            return
+        if marked_for_deletion:
+            self.mark_deleting(pool, claim.name)
+        else:
+            self.mark_active(pool, claim.name)
+
+
 class Cluster:
     """cluster.go:54 — the shared in-memory mirror."""
 
@@ -236,6 +324,7 @@ class Cluster:
         # pod uid -> (node name decided, timestamp) from the last Solve
         self.pod_scheduling_decisions: dict[str, tuple[str, float]] = {}
         self._consolidated_at: float = -1.0
+        self.nodepool_state = NodePoolState()  # cluster.go:68
 
     # -- Synced barrier (cluster.go:118) ---------------------------------
 
@@ -301,9 +390,15 @@ class Cluster:
         sn = self._state_node_for(new_pid)
         sn.node_claim = claim
         self.claim_name_to_pid[claim.name] = new_pid
+        # cluster.go:331: keep the per-pool claim-state sets in step
+        self.nodepool_state.update_node_claim(
+            claim,
+            claim.metadata.deletion_timestamp is not None or sn.marked_for_deletion,
+        )
         self.mark_unconsolidated()
 
     def delete_nodeclaim(self, name: str) -> None:
+        self.nodepool_state.cleanup(name)  # cluster.go:678
         pid = self.claim_name_to_pid.pop(name, None)
         if pid is None:
             return
@@ -459,6 +554,10 @@ class Cluster:
             sn = self.node_by_name(name) or self.node_by_claim_name(name)
             if sn is not None:
                 sn.marked_for_deletion = True
+                if sn.node_claim is not None:  # cluster.go:308
+                    self.nodepool_state.mark_deleting(
+                        sn.nodepool_name or "", sn.node_claim.name
+                    )
         self.mark_unconsolidated()
 
     def unmark_for_deletion(self, *names: str) -> None:
@@ -466,6 +565,10 @@ class Cluster:
             sn = self.node_by_name(name) or self.node_by_claim_name(name)
             if sn is not None:
                 sn.marked_for_deletion = False
+                if sn.node_claim is not None:  # cluster.go:291
+                    self.nodepool_state.mark_active(
+                        sn.nodepool_name or "", sn.node_claim.name
+                    )
 
     def schedulable_node_views(self) -> list[StateNodeView]:
         """The ExistingNode inputs for a provisioning Solve: registered,

@@ -27,6 +27,21 @@ STATIC_NODES = metrics.REGISTRY.gauge(
 _static_seq = [0]
 
 
+def node_limit(np: NodePool) -> float:
+    """The pool's `nodes` limit as a node count (limits are stored in
+    milli-units — utils/resources.py); unlimited when absent."""
+    return float(np.limits.get("nodes", float("inf"))) / 1000.0
+
+
+def owned_claims(kube: SimKube, nodepool: str) -> list[NodeClaim]:
+    """Non-deleting NodeClaims owned by the pool."""
+    return [
+        c
+        for c in kube.list("NodeClaim")
+        if c.nodepool_name == nodepool and c.metadata.deletion_timestamp is None
+    ]
+
+
 class StaticProvisioning:
     """Scale static pools up to replicas (provisioning/controller.go:69)."""
 
@@ -40,21 +55,25 @@ class StaticProvisioning:
         for np in self.kube.list("NodePool"):
             if np.replicas is None:
                 continue
-            owned = self._owned_claims(np.name)
-            STATIC_NODES.set(float(len(owned)), {"nodepool": np.name})
-            deficit = np.replicas - len(owned)
-            for _ in range(max(0, deficit)):
+            # provisioning/controller.go:83: count via NodePoolState —
+            # pending-disruption claims count as active (the disruption
+            # controller is already creating their replacements)
+            active, _, pending = self.cluster.nodepool_state.node_counts(np.name)
+            STATIC_NODES.set(float(active), {"nodepool": np.name})
+            if active + pending >= np.replicas:
+                continue
+            # provisioning/controller.go:93: reserve against the node limit
+            # so concurrent scale decisions can't burst over it
+            grant = self.cluster.nodepool_state.reserve_node_count(
+                np.name, node_limit(np), np.replicas - active
+            )
+            for _ in range(grant):
                 self._create_claim(np)
+                # the create marked the claim active (informer), so the
+                # reservation converts immediately (provisioner.go:166)
+                self.cluster.nodepool_state.release_node_count(np.name, 1)
                 created += 1
         return created
-
-    def _owned_claims(self, nodepool: str) -> list[NodeClaim]:
-        return [
-            c
-            for c in self.kube.list("NodeClaim")
-            if c.nodepool_name == nodepool
-            and c.metadata.deletion_timestamp is None
-        ]
 
     def _create_claim(self, np: NodePool) -> None:
         nct = NodeClaimTemplate(np)
@@ -85,13 +104,15 @@ class StaticDeprovisioning:
         for np in self.kube.list("NodePool"):
             if np.replicas is None:
                 continue
-            owned = [
-                c
-                for c in self.kube.list("NodeClaim")
-                if c.nodepool_name == np.name
-                and c.metadata.deletion_timestamp is None
-            ]
-            surplus = len(owned) - np.replicas
+            owned = owned_claims(self.kube, np.name)
+            # deprovisioning/controller.go:84: surplus from NodePoolState
+            active, _, pending = self.cluster.nodepool_state.node_counts(np.name)
+            if pending > 0:
+                # a StaticDrift rollout is replacing claims; scaling down
+                # now could delete the in-flight replacement and roll the
+                # disruption back — wait for the rollout to finish
+                continue
+            surplus = min(len(owned), active) - np.replicas
             if surplus <= 0:
                 continue
             # emptiest (fewest pods) first, newest as tiebreak

@@ -9,6 +9,8 @@ Reference behaviors: /root/reference/pkg/controllers/disruption/
 
 from __future__ import annotations
 
+import math
+
 import pytest
 
 from karpenter_tpu.api import labels as well_known
@@ -87,8 +89,9 @@ def test_budget_mapping():
     op = settled_operator()
     n_nodes = len(op.kube.list("Node"))
     budgets = build_budget_mapping(op.kube, op.cluster, "underutilized")
-    # default budget is 10% (rounded down) of the pool
-    assert budgets.allowed["default"] == max(0, int(n_nodes * 0.10))
+    # default budget is 10% rounded UP (nodepool.go:359 roundUp=true):
+    # even a 1-node pool allows one disruption
+    assert budgets.allowed["default"] == math.ceil(n_nodes * 0.10)
 
     np = op.kube.list("NodePool")[0]
     np.disruption.budgets[0].nodes = "100%"
@@ -292,3 +295,168 @@ def test_garbage_collection_both_directions():
     orphans, lost = op.garbage_collection.reconcile()
     assert orphans == 1
     assert "kwok://ghost" not in op.cloud.instances
+
+
+def test_static_drift_replaces_drifted_static_node():
+    """staticdrift.go:35-117: drifted static-pool nodes are replaced by the
+    StaticDrift method (regular Drift/consolidation must skip them)."""
+    from karpenter_tpu.controllers.operator import Operator
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.options import FeatureGates, Options
+
+    op = Operator(
+        clock=FakeClock(),
+        force_oracle=True,
+        options=Options(feature_gates=FeatureGates(static_capacity=True)),
+    )
+    op.kube.create("NodePool", fixtures.node_pool(name="warm", replicas=2))
+    op.run_until_settled(max_ticks=40)
+    claims = op.kube.list("NodeClaim")
+    assert len(claims) == 2
+    old_names = {c.name for c in claims}
+    assert op.cluster.nodepool_state.node_counts("warm") == (2, 0, 0)
+
+    # drift the pool: template change -> hash drift on existing claims
+    np = op.kube.list("NodePool")[0]
+    np.template.labels["fleet"] = "v2"
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    op.nodepool_hash.reconcile_all()
+    op.claim_conditions.reconcile_all()
+    drifted = [
+        c
+        for c in op.kube.list("NodeClaim")
+        if c.status.conditions.get(COND_DRIFTED) == "True"
+    ]
+    assert drifted, "hash change must mark static claims drifted"
+
+    for _ in range(80):
+        op.step(2.0)
+        current = {c.name for c in op.kube.list("NodeClaim")}
+        if current and not (current & old_names):
+            break
+    current = {c.name for c in op.kube.list("NodeClaim")}
+    assert current and not (current & old_names), "drifted static claims replaced"
+    # replica count is preserved throughout and afterwards
+    assert len(op.kube.list("Node")) == 2
+    assert op.cluster.nodepool_state.node_counts("warm")[0] == 2
+
+
+def test_static_drift_respects_node_limit_reservations():
+    """statenodepool.go ReserveNodeCount: with a `nodes` limit equal to the
+    replica count, StaticDrift cannot reserve a replacement slot, so the
+    drifted node stays (no burst over the limit)."""
+    from karpenter_tpu.controllers.operator import Operator
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.options import FeatureGates, Options
+
+    op = Operator(
+        clock=FakeClock(),
+        force_oracle=True,
+        options=Options(feature_gates=FeatureGates(static_capacity=True)),
+    )
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="warm", replicas=2, limits={"nodes": "2"}),
+    )
+    op.run_until_settled(max_ticks=40)
+    old_names = {c.name for c in op.kube.list("NodeClaim")}
+    assert len(old_names) == 2
+
+    np = op.kube.list("NodePool")[0]
+    np.template.labels["fleet"] = "v2"
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    op.nodepool_hash.reconcile_all()
+    op.claim_conditions.reconcile_all()
+
+    for _ in range(40):
+        op.step(2.0)
+    # limit 2 == replicas 2: reservation is denied, nothing is replaced
+    assert {c.name for c in op.kube.list("NodeClaim")} == old_names
+
+
+def test_static_drift_reservations_do_not_leak():
+    """Discarded/serialized StaticDrift commands must hand their node-count
+    reservations back; otherwise a later scale-up stalls below the limit."""
+    op = Operator(
+        clock=FakeClock(),
+        force_oracle=True,
+        options=__import__("karpenter_tpu.options", fromlist=["Options"]).Options(
+            feature_gates=__import__(
+                "karpenter_tpu.options", fromlist=["FeatureGates"]
+            ).FeatureGates(static_capacity=True)
+        ),
+    )
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="warm", replicas=3, limits={"nodes": "6"}),
+    )
+    op.run_until_settled(max_ticks=40)
+    assert len(op.kube.list("NodeClaim")) == 3
+
+    # drift all three claims
+    np = op.kube.list("NodePool")[0]
+    np.template.labels["fleet"] = "v2"
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    op.nodepool_hash.reconcile_all()
+    op.claim_conditions.reconcile_all()
+
+    # let the rollout finish (commands serialize one at a time)
+    for _ in range(200):
+        op.step(2.0)
+        claims = op.kube.list("NodeClaim")
+        if len(claims) == 3 and all(
+            c.status.conditions.get(COND_DRIFTED) != "True" for c in claims
+        ) and not op.disruption.queue.busy:
+            break
+    assert op.cluster.nodepool_state._reserved.get("warm", 0) == 0
+
+    # scale up to the limit: must reach 6, not stall below it
+    np = op.kube.list("NodePool")[0]
+    np.replicas = 6
+    op.kube.update("NodePool", np)
+    op.run_until_settled(max_ticks=60)
+    assert len(op.kube.list("NodeClaim")) == 6
+
+
+def test_static_drift_replaces_node_with_pods():
+    """A drifted static node carrying pods must still be replaced: StaticDrift
+    is eventual-class, so the consolidation re-simulation (which excludes
+    static pools) must not veto it."""
+    from karpenter_tpu.options import FeatureGates, Options
+
+    op = Operator(
+        clock=FakeClock(),
+        force_oracle=True,
+        options=Options(feature_gates=FeatureGates(static_capacity=True)),
+    )
+    op.kube.create("NodePool", fixtures.node_pool(name="warm", replicas=1))
+    op.run_until_settled(max_ticks=40)
+    # bind a pod onto the static node
+    node = op.kube.list("Node")[0]
+    p = fixtures.pod(name="rider", requests={"cpu": "100m"})
+    p.node_name = node.name
+    p.phase = PodPhase.RUNNING
+    op.kube.create("Pod", p)
+
+    old_names = {c.name for c in op.kube.list("NodeClaim")}
+    np = op.kube.list("NodePool")[0]
+    np.template.labels["fleet"] = "v2"
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    op.nodepool_hash.reconcile_all()
+    op.claim_conditions.reconcile_all()
+
+    for _ in range(120):
+        op.step(2.0)
+        current = {c.name for c in op.kube.list("NodeClaim")}
+        if current and not (current & old_names):
+            break
+    current = {c.name for c in op.kube.list("NodeClaim")}
+    assert current and not (current & old_names), (
+        "drifted static node with pods must be replaced (eventual class, "
+        "no simulation veto)"
+    )
+    assert op.cluster.nodepool_state._reserved.get("warm", 0) == 0

@@ -149,13 +149,22 @@ def test_spread_matrix(key, max_skew, n):
 @pytest.mark.parametrize("min_domains", [1, 2, 3, 4, 6])
 @pytest.mark.parametrize("max_skew", [1, 3])
 def test_min_domains(min_domains, max_skew):
-    run_parity(
+    # The KWOK universe spans 4 zones (cloudprovider/kwok.py). minDomains
+    # above that forces the global minimum to stay 0, capping every zone at
+    # maxSkew (topology.go minDomains semantics) — with maxSkew=1 only 4 of
+    # the 10 pods can land; the rest must error identically on both paths.
+    zones = 4
+    expect_errors = min_domains > zones and max_skew * zones < 10
+    r = run_parity(
         problem(
             lambda: spread_pods(
                 10, key=ZONE, max_skew=max_skew, min_domains=min_domains
             )
-        )
+        ),
+        expect_errors=expect_errors,
     )
+    if expect_errors:
+        assert r.pod_errors
 
 
 def test_min_domains_unsatisfiable_zone_subset():
