@@ -221,7 +221,14 @@ class MultiNodeConsolidation(ConsolidationBase):
     """multinodeconsolidation.go:51: find the LARGEST prefix of the
     disruption-cost-sorted candidates replaceable by <= 1 new node."""
 
-    def __init__(self, *args, sweep: str = "batched", **kwargs):
+    def __init__(self, *args, sweep: str = "binary", **kwargs):
+        """sweep="binary" (default): the reference's O(log N) sequential
+        bisection — currently the fastest end-to-end because each probe's
+        simulation is cheap relative to kernel dispatch. sweep="batched":
+        ONE vmapped device invocation evaluates every prefix simultaneously
+        (disruption/sweep.py) — the parallel-removal-sets capability; its
+        wall-clock is published honestly by bench.py config 4 and today it
+        only wins when per-probe simulations are expensive."""
         super().__init__(*args, **kwargs)
         assert sweep in ("batched", "binary")
         self.sweep = sweep
@@ -272,12 +279,24 @@ class MultiNodeConsolidation(ConsolidationBase):
         return best
 
     def first_n_batched(self, candidates: list[Candidate]) -> Command:
-        """The TPU-era replacement: evaluate EVERY prefix, largest feasible
-        wins. Each prefix simulation is an independent solve, so the sweep
-        is embarrassingly parallel across prefixes and rides the batched
-        TPU scheduler per solve; identical result to the binary search
-        (the feasibility predicate need not be monotone in the prefix —
-        sweeping all prefixes is strictly more robust than bisecting)."""
+        """The TPU-era replacement: ONE vmapped device invocation evaluates
+        the feasibility of every candidate prefix simultaneously
+        (disruption/sweep.py), then the real compute_consolidation
+        materializes the command for the largest feasible prefix — prices,
+        spot rules, and replacements byte-identical to the sequential
+        method. Shapes the sweep can't express (nodepool limits, features
+        outside the tensor encoding) fall back to a sequential
+        largest-first prefix scan, which is exact but O(N) simulations."""
+        if not self.force_oracle:
+            from karpenter_tpu.controllers.disruption.sweep import (
+                SweepUnsupported,
+                sweep_first_n,
+            )
+
+            try:
+                return sweep_first_n(self, candidates)
+            except SweepUnsupported:
+                pass
         best = Command(reason=self.reason)
         deadline = (
             self.clock.now() + self.opts.multinode_consolidation_timeout_seconds

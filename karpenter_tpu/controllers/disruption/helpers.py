@@ -31,6 +31,7 @@ class SimResults:
 
     results: Results
     pods: list[Pod]
+    used_tpu: bool = False  # which solver produced the simulation
 
     def all_pods_scheduled(self) -> bool:
         return not self.results.pod_errors and not self.results.timed_out
@@ -107,7 +108,10 @@ def simulate_scheduling(
         SchedulerOptions(timeout_seconds=opts.solve_timeout_seconds),
         force_oracle=force_oracle,
     )
-    return SimResults(results=scheduler.solve(pods), pods=pods)
+    results = scheduler.solve(pods)
+    return SimResults(
+        results=results, pods=pods, used_tpu=bool(scheduler.used_tpu)
+    )
 
 
 # ---------------------------------------------------------------------------

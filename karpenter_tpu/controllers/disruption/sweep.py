@@ -1,0 +1,383 @@
+"""Batched multi-node consolidation: evaluate every candidate-prefix
+removal set in ONE device invocation.
+
+The reference's multi-node consolidation binary-searches prefixes of the
+cost-sorted candidate list, running a full scheduling simulation per probe
+(/root/reference/pkg/controllers/disruption/multinodeconsolidation.go:116
+firstNConsolidationOption — ~log2(N) sequential simulations). This module
+replaces the search with a tensor sweep: the prefix index becomes a batch
+axis and a vmapped scan kernel (solver/tpu_kernel.solve_scan) solves all
+prefixes simultaneously — the "thousands of candidate removal sets in
+parallel" capability the TPU buys (BASELINE.json north star).
+
+Construction: one tensor problem holds every candidate node as an existing
+slot plus the union of all candidates' reschedulable pods in FFD order.
+Per prefix k:
+- candidate slots [0, k) are disabled (available = -1 never fits);
+- pods bound to candidates [k, N) stay bound: their topology-count
+  contributions are restored via per-candidate count deltas (prefix sums);
+- only the pods of candidates [0, k) are valid in the scan.
+A prefix is consolidation-feasible when every valid pod schedules and at
+most one new claim is opened (consolidation.go:184 multi-node replacements
+are never a win). The host then materializes the final Command for the
+largest feasible prefix through the real compute_consolidation path, so
+prices, spot rules, and replacement construction are byte-identical to the
+sequential method.
+
+Gates (fall back to the sequential prefix scan when violated): nodepool
+limits, reserved capacity — anything where per-prefix state diverges
+beyond availability and topology counts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.controllers.disruption.types import Candidate
+from karpenter_tpu.solver.oracle import Scheduler, SchedulerOptions
+from karpenter_tpu.solver.topology import ClusterSource, Topology
+from karpenter_tpu.solver.tpu import TpuScheduler
+from karpenter_tpu.solver.tpu_problem import UnsupportedBySolver, encode_problem
+
+MAX_SWEEP_PREFIXES = 128
+
+
+class SweepUnsupported(Exception):
+    """Problem shape outside the batched sweep; use the sequential scan."""
+
+
+def prefix_feasibility(
+    kube,
+    cluster,
+    cloud_provider,
+    candidates: list[Candidate],
+    options=None,
+) -> list[bool]:
+    """[len(candidates)] — feasible(k) for removing candidates[:k+1], all
+    prefixes evaluated in one vmapped device call."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.solver import tpu_kernel as K
+
+    B = len(candidates)
+    if B == 0:
+        return []
+    if B > MAX_SWEEP_PREFIXES:
+        raise SweepUnsupported(f"{B} prefixes > {MAX_SWEEP_PREFIXES}")
+
+    node_pools = [np_ for np_ in kube.list("NodePool") if np_.replicas is None]
+    if any(np_.limits for np_ in node_pools):
+        raise SweepUnsupported("nodepool limits make per-prefix state diverge")
+    # pods draining off OTHER deleting nodes are part of every sequential
+    # simulation (helpers.py:69-73); their per-prefix handling isn't modeled
+    # here, so bail to the sequential scan when any exist
+    candidate_names = {c.name for c in candidates}
+    for sn in cluster.state_nodes():
+        if sn.name in candidate_names:
+            continue
+        if sn.marked_for_deletion or sn.deleting():
+            from karpenter_tpu.controllers.state import is_reschedulable
+
+            if any(is_reschedulable(pd) for pd in cluster.pods_on(sn.name)):
+                raise SweepUnsupported(
+                    "reschedulable pods draining off non-candidate nodes"
+                )
+    its_by_pool = {
+        np_.name: cloud_provider.get_instance_types(np_) for np_ in node_pools
+    }
+    daemonset_pods = [ds.pod_template for ds in kube.list("DaemonSet")]
+
+    # union problem: every candidate node stays an existing slot; every
+    # candidate's reschedulable pods join the pod list
+    views = list(cluster.schedulable_node_views())
+    view_slot = {v.name: e for e, v in enumerate(views)}
+    missing = [c.name for c in candidates if c.name not in view_slot]
+    if missing:
+        raise SweepUnsupported(f"candidates missing from schedulable views: {missing}")
+
+    pods = []
+    pod_prefix = []  # pod i becomes valid from prefix index pod_prefix[i]
+    for j, c in enumerate(candidates):
+        for pod in c.reschedulable_pods:
+            pods.append(pod.deep_copy())
+            pod_prefix.append(j)
+    pending = kube.pending_pods()
+    for pod in pending:
+        pods.append(pod.deep_copy())
+        pod_prefix.append(-1)  # valid in every prefix
+
+    # full-cluster topology (all nodes, all bound pods)
+    pods_by_ns: dict[str, list] = {}
+    for pd in cluster.pods.values():
+        pods_by_ns.setdefault(pd.namespace, []).append(pd)
+    nodes_by_name = {
+        sn.name: sn.node for sn in cluster.state_nodes() if sn.node is not None
+    }
+    topology = Topology(
+        node_pools,
+        its_by_pool,
+        pods,
+        cluster=ClusterSource(pods_by_ns, nodes_by_name),
+        state_node_views=views,
+    )
+    sched = TpuScheduler(
+        node_pools,
+        its_by_pool,
+        topology,
+        views,
+        daemonset_pods,
+        SchedulerOptions(
+            timeout_seconds=getattr(options, "solve_timeout_seconds", None)
+        ),
+    )
+    try:
+        problem = encode_problem(sched.oracle, pods)
+    except UnsupportedBySolver as e:
+        raise SweepUnsupported(str(e)) from e
+
+    # FFD order shared with the oracle
+    from karpenter_tpu.solver.ordering import ffd_sort_key
+
+    data = sched.oracle.cached_pod_data
+    for pod in pods:
+        sched.oracle._update_cached_pod_data(pod)
+    order = sorted(
+        range(len(pods)),
+        key=lambda i: ffd_sort_key(pods[i], data[pods[i].uid].requests),
+    )
+
+    tb = sched._tables(problem)
+    sched._typeok = sched._pod_typeok(problem, tb)
+    sched._upload_pod_tables(problem)
+    # a consolidation-feasible prefix opens at most 1 new claim; a prefix
+    # that overflows even a handful of slots is infeasible anyway
+    N = 8
+    base = sched._init_state(problem, N)
+
+    # ---- per-candidate topology deltas ----------------------------------
+    # The base topology excluded every union pod from its counts (they're
+    # solve pods, topology.py excluded_pods), so the base reflects "every
+    # candidate removed". Per prefix k:
+    #   + add back the reschedulable-pod counts of KEPT candidates (j > k)
+    #   - remove the non-reschedulable-pod counts of REMOVED candidates
+    #     (their daemonset riders vanish with the node, helpers.go:52)
+    # replicating topology.py _count_domains (topology.go:328) per pod.
+    from karpenter_tpu.scheduling import Requirements
+    from karpenter_tpu.solver.tpu_problem import TERMINAL_PHASES
+
+    slot_of = [view_slot[c.name] for c in candidates]
+    Gv = base.v_cnt.shape[0]
+    VMAX = base.v_cnt.shape[1]
+    Gh = base.h_cnt.shape[0]
+    S = base.h_cnt.shape[1]
+    add_v = np.zeros((B, Gv, VMAX), np.int32)
+    rm_v = np.zeros((B, Gv, VMAX), np.int32)
+    add_h = np.zeros((B, Gh, S), np.int32)
+    rm_h = np.zeros((B, Gh, S), np.int32)
+    vocab = problem.vocab
+    union_uids = {p.uid for p in pods}
+    for j, c in enumerate(candidates):
+        sn = cluster.node_by_name(c.name)
+        node = sn.node if sn is not None else None
+        labels = dict(node.metadata.labels) if node is not None else {}
+        taints = list(node.taints) if node is not None else []
+        label_reqs = Requirements.from_labels(labels)
+        for pod in cluster.pods_on(c.name):
+            if pod.phase in TERMINAL_PHASES or pod.terminating:
+                continue
+            resched = pod.uid in union_uids
+            if pod.pod_anti_affinity and not resched:
+                # a bound, non-reschedulable anti-affinity pod creates an
+                # inverse group whose existence differs per prefix
+                raise SweepUnsupported(
+                    "non-reschedulable anti-affinity pod on candidate"
+                )
+            for g, vg in enumerate(problem.vgroups):
+                tg = vg.group
+                if pod.namespace not in tg.namespaces:
+                    continue
+                if tg.selector is None or not tg.selector.matches(
+                    pod.metadata.labels
+                ):
+                    continue
+                dom = labels.get(tg.key)
+                if dom is None:
+                    continue
+                if not tg.node_filter.matches(taints, label_reqs):
+                    continue
+                vid = vocab.value_index[vg.kid].get(dom)
+                if vid is None:
+                    continue
+                (add_v if resched else rm_v)[j, g, vid] += 1
+            for g, hg in enumerate(problem.hgroups):
+                if hg.inverse:
+                    continue  # gated above
+                tg = hg.group
+                if pod.namespace not in tg.namespaces:
+                    continue
+                if tg.selector is None or not tg.selector.matches(
+                    pod.metadata.labels
+                ):
+                    continue
+                if not tg.node_filter.matches(taints, label_reqs):
+                    continue
+                (add_h if resched else rm_h)[j, g, slot_of[j]] += 1
+
+    # prefix k (0-based) removes candidates[:k+1]
+    cum_add_v = np.cumsum(add_v, axis=0)
+    cum_rm_v = np.cumsum(rm_v, axis=0)
+    cum_add_h = np.cumsum(add_h, axis=0)
+    cum_rm_h = np.cumsum(rm_h, axis=0)
+    tot_add_v = cum_add_v[-1]
+    tot_add_h = cum_add_h[-1]
+
+    # ---- batched state ---------------------------------------------------
+    eavail_b = np.broadcast_to(
+        np.asarray(base.eavail), (B,) + base.eavail.shape
+    ).copy()
+    for k in range(B):
+        for j in range(k + 1):
+            eavail_b[k, slot_of[j], :] = -1  # removed: fits nothing
+    v_cnt_b = (
+        np.asarray(base.v_cnt)[None]
+        + (tot_add_v[None] - cum_add_v)
+        - cum_rm_v
+    )
+    h_cnt_b = (
+        np.asarray(base.h_cnt)[None]
+        + (tot_add_h[None] - cum_add_h)
+        - cum_rm_h
+    )
+
+    xs = sched._pod_xs(problem, order)
+    P_pad = int(xs.valid.shape[0])
+    valid_b = np.zeros((B, P_pad), bool)
+    pp = np.asarray([pod_prefix[i] for i in order])
+    for k in range(B):
+        valid_b[k, : len(order)] = pp <= k
+
+    st_axes = K.State(
+        active=None, count=None, rank=None, tmpl=None,
+        creq=type(base.creq)(*(None,) * len(base.creq)),
+        crequests=None, alive=None, cmax_alloc=None, n_claims=None,
+        ereq=type(base.ereq)(*(None,) * len(base.ereq)),
+        eavail=0, trem=None, v_cnt=0, h_cnt=0,
+    )
+    xs_axes = K.PodX(
+        preq=type(xs.preq)(*(None,) * len(xs.preq)),
+        prequests=None, typeok=None, tol_t=None, tol_e=None,
+        topo_kind=None, topo_gid=None, topo_sel=None,
+        sel_v=None, sel_h=None, inv_h=None, own_h=None, valid=0,
+    )
+    st_b = base._replace(
+        eavail=jnp.asarray(eavail_b),
+        v_cnt=jnp.asarray(v_cnt_b),
+        h_cnt=jnp.asarray(h_cnt_b),
+    )
+    xs_b = xs._replace(valid=jnp.asarray(valid_b))
+
+    sweep = jax.jit(
+        jax.vmap(K.solve_scan, in_axes=(None, st_axes, xs_axes))
+    )
+    st_out, kinds, slots, over = sweep(tb, st_b, xs_b)
+    kinds = np.asarray(jax.device_get(kinds))  # [B, P_pad]
+    n_claims = np.asarray(jax.device_get(st_out.n_claims))  # [B]
+    over = np.asarray(jax.device_get(over))
+
+    feasible = []
+    for k in range(B):
+        ok = (
+            not bool(over[k])
+            and int(n_claims[k]) <= 1
+            and not np.any(
+                (kinds[k, : len(order)] == K.KIND_FAIL) & (pp <= k)
+            )
+        )
+        feasible.append(ok)
+    return feasible
+
+
+def sweep_first_n(consolidation, candidates: list[Candidate]):
+    """Drop-in for MultiNodeConsolidation's prefix search: one batched
+    feasibility sweep, then the real compute_consolidation on the largest
+    feasible prefix (prices/spot rules byte-identical to the sequential
+    path). Returns a Command."""
+    from karpenter_tpu.controllers.disruption.types import Command
+
+    feasible = prefix_feasibility(
+        consolidation.kube,
+        consolidation.cluster,
+        consolidation.cloud,
+        candidates,
+        consolidation.opts,
+    )
+    for k in range(len(candidates), 0, -1):
+        if not feasible[k - 1]:
+            continue
+        cmd = consolidation.compute_consolidation(candidates[:k])
+        if cmd.decision != "no-op":
+            return cmd
+    return Command(reason=consolidation.reason)
+
+
+# ---------------------------------------------------------------------------
+# benchmark harness (BASELINE.json config 4)
+
+
+def bench_sweep(n_nodes: int = 2000, n_candidates: int = 100) -> dict:
+    """2k under-utilized nodes; compare one batched prefix sweep against the
+    reference-style sequential binary search (per-probe full simulation)."""
+    from karpenter_tpu.controllers.disruption.consolidation import (
+        MultiNodeConsolidation,
+    )
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.operator import Operator
+    from karpenter_tpu.testing import fixtures
+
+    from karpenter_tpu.api.objects import Budget
+
+    op = Operator(clock=FakeClock(), force_oracle=False)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="default", budgets=[Budget(nodes="100%")]),
+    )
+    fixtures.reset_rng(7)
+    fixtures.make_underutilized_fleet(op, n_nodes, max_ticks=400)
+    op.clock.advance(30.0)
+    op.pod_events.reconcile_all()
+    op.claim_conditions.reconcile_all()
+
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    mnc = MultiNodeConsolidation(*args, options=op.opts, force_oracle=True)
+    candidates = mnc.candidates()[:n_candidates]
+
+    # batched sweep: warm once (compile), then steady state
+    t0 = time.monotonic()
+    feasible = prefix_feasibility(op.kube, op.cluster, op.cloud, candidates, op.opts)
+    compile_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    feasible = prefix_feasibility(op.kube, op.cluster, op.cloud, candidates, op.opts)
+    sweep_s = time.monotonic() - t0
+
+    # sequential binary search (reference method shape)
+    t0 = time.monotonic()
+    cmd_binary = mnc.first_n_binary(candidates)
+    binary_s = time.monotonic() - t0
+
+    largest = max((i + 1 for i, f in enumerate(feasible) if f), default=0)
+    return {
+        "nodes": n_nodes,
+        "prefixes_evaluated": len(candidates),
+        "sweep_seconds": round(sweep_s, 3),
+        "sweep_compile_seconds": round(compile_s, 1),
+        "binary_search_seconds": round(binary_s, 3),
+        "speedup": round(binary_s / sweep_s, 2) if sweep_s else None,
+        "largest_feasible_prefix": largest,
+        "binary_prefix": len(cmd_binary.candidates),
+        "agree": largest == len(cmd_binary.candidates),
+    }

@@ -107,7 +107,8 @@ class SimKube:
         if name in store:
             raise AlreadyExists(f"{kind}/{name}")
         obj = copy.deepcopy(obj)
-        obj.metadata.resource_version = next(self._version)
+        if getattr(obj, "metadata", None) is not None:
+            obj.metadata.resource_version = next(self._version)
         store[name] = obj
         self._emit(ADDED, kind, copy.deepcopy(obj))
         return copy.deepcopy(obj)

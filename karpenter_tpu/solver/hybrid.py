@@ -77,16 +77,57 @@ class HybridScheduler:
         self.opts = self.oracle.opts
 
     def solve(self, pods: list[Pod]) -> Results:
-        """Never raises UnsupportedBySolver."""
-        if self.tpu is not None:
-            try:
-                results = self.tpu.solve(pods)
-                self.used_tpu = True
-                self.fallback_reason = None
-                return results
-            except UnsupportedBySolver as e:
-                # encode_problem raises before mutating the oracle or the
-                # shared Topology, so the oracle can run on the same state
-                self.fallback_reason = str(e)
-        self.used_tpu = False
-        return self.oracle.solve(pods)
+        """Never raises UnsupportedBySolver.
+
+        Per-pod partitioning (the round-2 "fallback cliff" fix): pods the
+        tensor encoding supports ride the kernel; the remainder (relaxable
+        preferences, ScheduleAnyway, host ports, volumes, hostname
+        selectors) then run through the oracle AGAINST THE KERNEL'S
+        RESULTING STATE — the decode writes claims/existing usage back onto
+        the shared oracle and syncs the Topology's domain counts from the
+        device, so the continuation packs into the same cluster picture.
+        One odd pod no longer drags a 10k-pod batch onto the oracle.
+        """
+        if self.tpu is None:
+            self.used_tpu = False
+            return self.oracle.solve(pods)
+
+        from karpenter_tpu.solver.tpu_problem import pod_unsupported_reason
+
+        reasons = [pod_unsupported_reason(p) for p in pods]
+        supported = [p for p, r in zip(pods, reasons) if r is None]
+        unsupported = [p for p, r in zip(pods, reasons) if r is not None]
+        first_reason = next((r for r in reasons if r is not None), None)
+        # nodepool limits are tracked on-device and not synced back yet, so
+        # a partitioned continuation would double-spend them — whole-batch
+        # fallback keeps limit accounting exact
+        can_partition = (
+            supported
+            and unsupported
+            and not self.oracle.remaining_resources
+        )
+        if unsupported and not can_partition:
+            self.used_tpu = False
+            self.fallback_reason = first_reason
+            return self.oracle.solve(pods)
+        try:
+            results = self.tpu.solve(supported)
+        except UnsupportedBySolver as e:
+            # encode_problem raises before mutating the oracle or the
+            # shared Topology, so the oracle can run on the same state
+            self.fallback_reason = str(e)
+            self.used_tpu = False
+            return self.oracle.solve(pods)
+        self.used_tpu = True
+        self.fallback_reason = None
+        if not unsupported:
+            return results
+        # continuation: the oracle packs the leftovers into the decoded
+        # claims/existing nodes (state and topology already synced)
+        self.fallback_reason = (
+            f"{len(unsupported)} pod(s) continued on the oracle: {first_reason}"
+        )
+        cont = self.oracle.solve(unsupported)
+        cont.pod_errors.update(results.pod_errors)
+        cont.timed_out = cont.timed_out or results.timed_out
+        return cont

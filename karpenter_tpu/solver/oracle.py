@@ -56,15 +56,12 @@ class Queue:
     detection via per-pod lastLen."""
 
     def __init__(self, pods: list[Pod], pod_data: dict[str, PodData]):
+        from karpenter_tpu.solver.ordering import ffd_sort_key
+
         self.pods = deque(
             sorted(
                 pods,
-                key=lambda p: (
-                    -pod_data[p.uid].requests.get(res.CPU, 0),
-                    -pod_data[p.uid].requests.get(res.MEMORY, 0),
-                    p.metadata.creation_timestamp,
-                    p.uid,
-                ),
+                key=lambda p: ffd_sort_key(p, pod_data[p.uid].requests),
             )
         )
         self.last_len: dict[str, int] = {}

@@ -1,0 +1,139 @@
+"""The shared FFD pod ordering, with a class-grouped tie-break.
+
+The reference sorts pods by CPU-then-memory descending, breaking ties by
+creation timestamp then UID (queue.go:72-108). The tie-break is pure
+determinism — any total order over equal-request pods yields a valid
+first-fit-decreasing run. This framework inserts one extra key between the
+requests and the timestamp: a *scheduling-class signature*, a hash of every
+pod field that influences the scheduler's per-pod decision (requirements,
+constraints, tolerations — NOT the pod's own labels, which only affect what
+the pod records into topology counts, never where it can go).
+
+Why: pods of the same class become contiguous in the solve order, which
+lets the TPU kernel evaluate a class once and bulk-commit whole runs of
+identical pods per device step (solver/tpu_kernel.py run scan) instead of
+one pod per step. The oracle uses the same comparator, so oracle/TPU parity
+is preserved exactly.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+from karpenter_tpu.api.objects import Pod, PodAffinityTerm
+from karpenter_tpu.utils import resources as res
+
+
+def _selector_key(sel) -> tuple:
+    if sel is None:
+        return ()
+    return (
+        tuple(sorted(sel.match_labels.items())),
+        tuple(
+            (e.key, str(e.operator), tuple(sorted(e.values)))
+            for e in sel.match_expressions
+        ),
+    )
+
+
+def _term_key(t: PodAffinityTerm, pod: Pod) -> tuple:
+    sel = t.label_selector
+    return (
+        t.topology_key,
+        _selector_key(sel),
+        tuple(sorted(t.namespaces or ())),
+        _selector_key(getattr(t, "namespace_selector", None)),
+        # whether the term selects the pod itself changes the decision
+        # (self-counting in skew math), so it is part of the class
+        bool(sel is not None and sel.matches(pod.metadata.labels)),
+    )
+
+
+def pod_class_signature(pod: Pod) -> int:
+    """A hash over every decision-relevant pod field. Two pods with equal
+    signatures and equal requests make identical scheduling decisions
+    against any solver state (their labels may still differ — labels only
+    drive topology-count records, which the kernel applies per pod).
+    Memoized on the pod object: the sort and the encoder both consult it
+    for every pod of every solve."""
+    cached = getattr(pod, "_ktpu_class_sig", None)
+    if cached is not None:
+        return cached
+    na = pod.node_affinity
+    key = (
+        pod.namespace,
+        tuple(sorted(pod.node_selector.items())),
+        tuple(
+            (
+                tuple(
+                    (e.key, str(e.operator), tuple(sorted(e.values)))
+                    for e in term.match_expressions
+                ),
+            )
+            for term in (na.required_terms if na else ())
+        ),
+        tuple(
+            (
+                w.weight,
+                tuple(
+                    (e.key, str(e.operator), tuple(sorted(e.values)))
+                    for e in w.preference.match_expressions
+                ),
+            )
+            for w in (na.preferred if na else ())
+        ),
+        tuple(_term_key(t, pod) for t in pod.pod_affinity),
+        tuple(_term_key(t, pod) for t in pod.pod_anti_affinity),
+        tuple(
+            (w.weight,) + _term_key(w.term, pod) for w in pod.pod_affinity_preferred
+        ),
+        tuple(
+            (w.weight,) + _term_key(w.term, pod)
+            for w in pod.pod_anti_affinity_preferred
+        ),
+        tuple(
+            (t.key, t.operator, t.value, str(t.effect)) for t in pod.tolerations
+        ),
+        tuple(
+            (
+                t.topology_key,
+                t.max_skew,
+                str(t.when_unsatisfiable),
+                _selector_key(t.label_selector),
+                t.min_domains,
+                str(t.node_taints_policy),
+                str(t.node_affinity_policy),
+                bool(
+                    t.label_selector is not None
+                    and t.label_selector.matches(pod.metadata.labels)
+                ),
+            )
+            for t in pod.topology_spread_constraints
+        ),
+        tuple(sorted(pod.host_ports)),
+        tuple(sorted(pod.volume_claims)),
+    )
+    # crc over the canonical repr: stable across processes (unlike hash())
+    sig = zlib.crc32(repr(key).encode())
+    try:
+        pod._ktpu_class_sig = sig
+    except AttributeError:
+        pass  # frozen/slotted pods just recompute
+    return sig
+
+
+def pod_encode_class(pod: Pod, requests) -> tuple:
+    """Key under which pods share identical solver encodings: the class
+    signature plus the exact request vector."""
+    return (pod_class_signature(pod), tuple(sorted(requests.items())))
+
+
+def ffd_sort_key(pod: Pod, requests: res.ResourceList):
+    """queue.go:72 FFD order + class-grouped tie-break (module docstring)."""
+    return (
+        -requests.get(res.CPU, 0),
+        -requests.get(res.MEMORY, 0),
+        pod_class_signature(pod),
+        pod.metadata.creation_timestamp,
+        pod.uid,
+    )

@@ -7,13 +7,22 @@ Wire protocol (language-neutral; the C++ client in native/solver_client.cc
 speaks it too):
 
     frame   := magic "KTPU" | u32 kind | u32 len | payload[len]
-    kind    := 1 SOLVE request   (payload = problem JSON, api/codec.py)
-               2 RESULT response (payload = result JSON + flat assignment
-                                  arrays base64'd in-header for small
-                                  problems; see _encode_result)
+    kind    := 1 SOLVE request   (payload = problem JSON; pods ride as
+                                  per-CLASS specs + flat base64 columns,
+                                  SURVEY §7 hard-part #5 — the per-pod
+                                  payload is O(classes) JSON + O(pods)
+                                  binary, not O(pods) JSON)
+               2 RESULT response (payload = JSON header + flat base64
+                                  assignment arrays: pod i -> claim index /
+                                  existing-node index)
                3 ERROR response  (payload = utf-8 message)
                4 PING / 5 PONG   (health)
     u32     := little-endian
+
+Live cluster state (StateNodeViews) crosses the wire too, so a sidecar
+solve of a NON-empty cluster — provisioning onto existing capacity,
+consolidation simulation — matches the in-process result
+(tests/test_service.py asserts equality).
 
 Timeout/cancellation follows provisioner.go:366-374: the request carries
 `timeout_seconds`; the server passes it into SchedulerOptions so a Solve
@@ -23,6 +32,7 @@ hanging the control plane.
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import socket
@@ -30,8 +40,11 @@ import struct
 import threading
 from typing import Optional
 
+import numpy as np
+
 from karpenter_tpu.api import codec
 from karpenter_tpu.solver.hybrid import HybridScheduler
+from karpenter_tpu.solver.nodes import StateNodeView
 from karpenter_tpu.solver.oracle import SchedulerOptions
 from karpenter_tpu.solver.topology import Topology
 
@@ -69,6 +82,112 @@ def _recv_frame(sock: socket.socket) -> tuple[int, bytes]:
 # problem wire form
 
 
+def _b64(arr: np.ndarray) -> str:
+    return base64.b64encode(np.ascontiguousarray(arr).tobytes()).decode()
+
+
+def _unb64(s: str, dtype) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(s), dtype=dtype)
+
+
+def _encode_pods_flat(pods) -> dict:
+    """Class-deduplicated pod payload: one JSON spec per scheduling class
+    plus flat per-pod identity columns (SURVEY §7 hard-part #5 — the wire
+    cost is O(classes) JSON + O(pods) binary)."""
+    from karpenter_tpu.solver.ordering import pod_encode_class
+
+    classes: dict[tuple, int] = {}
+    reps = []
+    cls = np.zeros(len(pods), np.int32)
+    for i, p in enumerate(pods):
+        key = pod_encode_class(p, p.requests) + (
+            tuple(sorted(p.metadata.labels.items())),
+            tuple(sorted(p.metadata.annotations.items())),
+            p.namespace,
+        )
+        c = classes.get(key)
+        if c is None:
+            c = len(reps)
+            classes[key] = c
+            reps.append(p)
+        cls[i] = c
+    return {
+        "classes": codec.to_jsonable(reps),
+        "cls": _b64(cls),
+        "names": [p.name for p in pods],
+        "uids": [p.uid for p in pods],
+        "creation": _b64(
+            np.asarray([p.metadata.creation_timestamp for p in pods], np.float64)
+        ),
+    }
+
+
+def _decode_pods_flat(d: dict):
+    reps = codec.from_jsonable(d["classes"])
+    cls = _unb64(d["cls"], np.int32)
+    creation = _unb64(d["creation"], np.float64)
+    out = []
+    for i in range(len(cls)):
+        p = reps[int(cls[i])].deep_copy()
+        p.metadata.name = d["names"][i]
+        p.metadata.uid = d["uids"][i]
+        p.metadata.creation_timestamp = float(creation[i])
+        out.append(p)
+    return out
+
+
+def _encode_views(views) -> list[dict]:
+    out = []
+    for v in views or []:
+        out.append(
+            {
+                "name": v.name,
+                "node_labels": v.node_labels,
+                "labels": dict(v.labels),
+                "taints": codec.to_jsonable(list(v.taints)),
+                "available": dict(v.available),
+                "capacity": dict(v.capacity),
+                "daemonset_requests": dict(v.daemonset_requests),
+                "initialized": v.initialized,
+                "hostname": v.hostname,
+                "host_ports": {
+                    uid: [list(p) for p in ports]
+                    for uid, ports in v.host_port_usage._by_pod.items()
+                },
+                "volumes": {
+                    uid: sorted(s) for uid, s in v.volume_usage._by_pod.items()
+                },
+            }
+        )
+    return out
+
+
+def _decode_views(data) -> Optional[list[StateNodeView]]:
+    if data is None:
+        return None
+    out = []
+    for d in data:
+        v = StateNodeView(
+            name=d["name"],
+            node_labels=d["node_labels"],
+            labels=d["labels"],
+            taints=codec.from_jsonable(d["taints"]),
+            available={k: int(x) for k, x in d["available"].items()},
+            capacity={k: int(x) for k, x in d["capacity"].items()},
+            daemonset_requests={
+                k: int(x) for k, x in d["daemonset_requests"].items()
+            },
+            initialized=d["initialized"],
+            hostname=d["hostname"],
+        )
+        for uid, ports in d.get("host_ports", {}).items():
+            v.host_port_usage._by_pod[uid] = [tuple(p) for p in ports]
+        for uid, vols in d.get("volumes", {}).items():
+            v.volume_usage._by_pod[uid] = set(vols)
+        out.append(v)
+    return out
+
+
 def encode_problem_request(
     node_pools,
     instance_types_by_pool,
@@ -83,8 +202,10 @@ def encode_problem_request(
         "instance_types_by_pool": {
             k: codec.to_jsonable(list(v)) for k, v in instance_types_by_pool.items()
         },
-        "pods": codec.to_jsonable(pods),
-        "state_node_views": None,  # views carry live handles; service solves fresh
+        "pods_flat": _encode_pods_flat(pods),
+        "state_node_views": (
+            _encode_views(state_node_views) if state_node_views is not None else None
+        ),
         "daemonset_pods": codec.to_jsonable(daemonset_pods or []),
         "options": {
             "ignore_preferences": bool(options and options.ignore_preferences),
@@ -102,7 +223,8 @@ def _decode_problem_request(payload: bytes):
     its_by_pool = {
         k: codec.from_jsonable(v) for k, v in req["instance_types_by_pool"].items()
     }
-    pods = codec.from_jsonable(req["pods"])
+    pods = _decode_pods_flat(req["pods_flat"])
+    views = _decode_views(req.get("state_node_views"))
     daemons = codec.from_jsonable(req.get("daemonset_pods") or [])
     o = req.get("options") or {}
     options = SchedulerOptions(
@@ -110,30 +232,72 @@ def _decode_problem_request(payload: bytes):
         min_values_best_effort=o.get("min_values_best_effort", False),
         timeout_seconds=o.get("timeout_seconds"),
     )
-    return node_pools, its_by_pool, pods, daemons, options, req.get("force_oracle", False)
+    return (
+        node_pools,
+        its_by_pool,
+        pods,
+        views,
+        daemons,
+        options,
+        req.get("force_oracle", False),
+    )
 
 
-def _encode_result(results, used_tpu: bool) -> bytes:
-    claims = []
-    for c in results.new_node_claims:
-        claims.append(
-            {
-                "nodepool": c.nodepool_name,
-                "pod_uids": [p.uid for p in c.pods],
-                "instance_types": [it.name for it in c.instance_type_options],
-                "requests": dict(c.requests),
-            }
-        )
+def _encode_result(results, used_tpu: bool, pods) -> bytes:
+    """Flat assignment arrays: pod i (request order) -> claim index, or
+    ~existing-node index; -1 = error/unscheduled."""
+    claim_of = {}
+    for ci, c in enumerate(results.new_node_claims):
+        for p in c.pods:
+            claim_of[p.uid] = ci
+    enode_names = [n.name for n in results.existing_nodes]
+    enode_of = {}
+    for ei, n in enumerate(results.existing_nodes):
+        for p in n.pods:
+            enode_of[p.uid] = ei
+    assign = np.full(len(pods), -1, np.int32)
+    for i, p in enumerate(pods):
+        if p.uid in claim_of:
+            assign[i] = claim_of[p.uid]
+        elif p.uid in enode_of:
+            assign[i] = -2 - enode_of[p.uid]  # -2 -> node 0, -3 -> node 1, ...
+    claims = [
+        {
+            "nodepool": c.nodepool_name,
+            "instance_types": [it.name for it in c.instance_type_options],
+            "requests": dict(c.requests),
+        }
+        for c in results.new_node_claims
+    ]
     out = {
         "used_tpu": used_tpu,
         "timed_out": results.timed_out,
         "pod_errors": dict(results.pod_errors),
         "new_node_claims": claims,
-        "existing_assignments": {
-            p.uid: n.name for n in results.existing_nodes for p in n.pods
-        },
+        "existing_node_names": enode_names,
+        "assign": _b64(assign),
     }
     return json.dumps(out).encode()
+
+
+def decode_result(resp: dict, pods) -> dict:
+    """Expand the flat assignment array back into per-pod maps."""
+    assign = _unb64(resp["assign"], np.int32)
+    claims = [dict(c, pod_uids=[]) for c in resp["new_node_claims"]]
+    existing = {}
+    for i, p in enumerate(pods):
+        a = int(assign[i])
+        if a >= 0:
+            claims[a]["pod_uids"].append(p.uid)
+        elif a <= -2:
+            existing[p.uid] = resp["existing_node_names"][-2 - a]
+    return {
+        "used_tpu": resp["used_tpu"],
+        "timed_out": resp["timed_out"],
+        "pod_errors": resp["pod_errors"],
+        "new_node_claims": claims,
+        "existing_assignments": existing,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -204,23 +368,26 @@ class SolverServer:
             node_pools,
             its_by_pool,
             pods,
+            views,
             daemons,
             options,
             force_oracle,
         ) = _decode_problem_request(payload)
-        topology = Topology(node_pools, its_by_pool, pods)
+        topology = Topology(
+            node_pools, its_by_pool, pods, state_node_views=views
+        )
         scheduler = HybridScheduler(
             node_pools,
             its_by_pool,
             topology,
-            None,
+            views,
             daemons,
             options,
             force_oracle=force_oracle,
         )
         results = scheduler.solve(pods)
         self.solves += 1
-        return _encode_result(results, bool(scheduler.used_tpu))
+        return _encode_result(results, bool(scheduler.used_tpu), pods)
 
 
 # ---------------------------------------------------------------------------
@@ -252,6 +419,7 @@ class SolverClient:
         node_pools,
         instance_types_by_pool,
         pods,
+        state_node_views=None,
         daemonset_pods=None,
         options: Optional[SchedulerOptions] = None,
         force_oracle: bool = False,
@@ -260,7 +428,7 @@ class SolverClient:
             node_pools,
             instance_types_by_pool,
             pods,
-            None,
+            state_node_views,
             daemonset_pods,
             options,
             force_oracle,
@@ -269,4 +437,4 @@ class SolverClient:
         kind, resp = _recv_frame(self._sock)
         if kind == KIND_ERROR:
             raise RuntimeError(resp.decode())
-        return json.loads(resp)
+        return decode_result(json.loads(resp), pods)

@@ -23,6 +23,7 @@ equivalent to the reference's requeue-at-end + stall detection.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import time as time_mod
 from typing import Optional
@@ -92,6 +93,120 @@ def _pow2(n: int, floor: int = 8) -> int:
     return p
 
 
+_gather_xs_cached = None
+
+
+def _gather_xs(tables, idx, valid):
+    """Device-side PodX assembly: gather class rows + per-pod selection
+    rows for a round's pod indices."""
+    global _gather_xs_cached
+    if _gather_xs_cached is None:
+        import jax
+
+        def impl(tables, idx, valid):
+            from karpenter_tpu.solver import tpu_kernel as K
+
+            (
+                preq_c, prequests_c, typeok_c, tol_t_c, tol_e_c,
+                kind_c, gid_c, tsel_c, cls, sel_v, sel_h, inv_h, own_h,
+            ) = tables
+            ci = cls[idx]
+            return K.PodX(
+                preq=Reqs(*(a[ci] for a in preq_c)),
+                prequests=prequests_c[ci],
+                typeok=typeok_c[ci],
+                tol_t=tol_t_c[ci],
+                tol_e=tol_e_c[ci],
+                topo_kind=kind_c[ci],
+                topo_gid=gid_c[ci],
+                topo_sel=tsel_c[ci],
+                sel_v=sel_v[idx],
+                sel_h=sel_h[idx],
+                inv_h=inv_h[idx],
+                own_h=own_h[idx],
+                valid=valid,
+            )
+
+        _gather_xs_cached = jax.jit(impl)
+    return _gather_xs_cached(tables, idx, valid)
+
+
+def _popcount_rows(seg: np.ndarray) -> np.ndarray:
+    return np.unpackbits(
+        seg.astype("<u4").view(np.uint8), axis=-1
+    ).sum(axis=-1)
+
+
+def _bulk_gates(p: EncodedProblem) -> bool:
+    """Problem-level gates for the run kernel's bulk phases (see
+    solver/tpu_runs.py module docstring). When any fails, every pod runs
+    the exact per-pod step inside the same kernel — correctness never
+    depends on these."""
+    if (p.treq.minv != -1).any() or (p.preq.minv != -1).any():
+        return False
+    if p.num_existing and (p.ereq.minv != -1).any():
+        return False
+    if p.thas_limits.any():
+        return False
+    vocab = p.vocab
+    # instance-type requirement structure: pairwise screens are exact
+    # three-way only when every concrete type key is single-valued or spans
+    # the whole vocab segment
+    for kid in range(vocab.num_keys):
+        off, words = vocab.word_offset[kid], vocab.words_per_key[kid]
+        nvals = len(vocab.values[kid])
+        pop = _popcount_rows(p.ireq.mask[:, off : off + words])
+        concrete = p.ireq.defined[:, kid] & ~p.ireq.other[:, kid]
+        if (concrete & (pop > 1) & (pop < nvals)).any():
+            return False
+    # offerings decompose per key: every capacity-type a type offers must
+    # cover the same zone set (so "an offering exists for the chosen zone"
+    # is independent of which zone the tighten picks)
+    zone_kid = vocab.key_index.get(well_known.TOPOLOGY_ZONE_LABEL_KEY)
+    per_type: dict[int, dict[int, set]] = {}
+    for o in range(p.otype.shape[0]):
+        i = int(p.otype[o])
+        if p.oword[o, 2] != -1:
+            return False  # reservation-id offerings
+        zw, cw = int(p.oword[o, 0]), int(p.oword[o, 1])
+        z = -1 if zw == -1 else zw * 32 + int(p.obit[o, 0])
+        c = -1 if cw == -1 else cw * 32 + int(p.obit[o, 1])
+        per_type.setdefault(i, {}).setdefault(c, set()).add(z)
+    for zones_by_ct in per_type.values():
+        wildcard = zones_by_ct.pop(-1, None)
+        if wildcard is not None and -1 in wildcard:
+            continue  # a fully unconstrained offering covers everything
+        sets = [frozenset(v) for v in zones_by_ct.values()]
+        if sets and len(set(sets)) > 1 and not any(-1 in s for s in sets):
+            return False
+    return True
+
+
+def _bulk_pod_flags(p: EncodedProblem, gates_ok: bool) -> np.ndarray:
+    """[P] bool — pod's class admits bulk phases. Only self-selecting
+    zone-family spread/anti constraints are dynamic beyond what the kernel's
+    per-slot hostname budgets model (their domain counts move mid-run), so
+    only those force the exact per-pod path."""
+    from karpenter_tpu.solver.tpu_problem import TOPO_ANTI_V, TOPO_SPREAD_V
+
+    P = len(p.pods)
+    if not gates_ok:
+        return np.zeros(P, bool)
+    dyn_v = np.isin(p.ptopo_kind, (TOPO_SPREAD_V, TOPO_ANTI_V)) & p.ptopo_sel
+    return ~dyn_v.any(axis=1)
+
+
+
+
+_DecodeView = collections.namedtuple(
+    "_DecodeView",
+    [
+        "n_claims", "creq", "crequests", "alive", "tmpl", "eavail",
+        "ereq", "v_cnt", "h_cnt",
+    ],
+)
+
+
 class TpuScheduler:
     """Same surface as oracle.Scheduler, solving on the accelerator."""
 
@@ -123,6 +238,11 @@ class TpuScheduler:
         back to the oracle."""
         import jax  # deferred so encoding errors surface first
 
+        if not pods:
+            return Results(
+                new_node_claims=[], existing_nodes=self.oracle.existing_nodes,
+                pod_errors={},
+            )
         problem = encode_problem(self.oracle, pods)
         deadline = (
             time_mod.monotonic() + self.opts.timeout_seconds
@@ -130,24 +250,29 @@ class TpuScheduler:
             else None
         )
 
-        # FFD order (queue.go:72): cpu desc, memory desc, creation, uid
+        # FFD order shared with the oracle (solver/ordering.py): cpu desc,
+        # memory desc, class signature, creation, uid — class grouping makes
+        # identical pods contiguous for the run kernel
+        from karpenter_tpu.solver.ordering import ffd_sort_key
+
         data = self.oracle.cached_pod_data
         for p in pods:
             self.oracle._update_cached_pod_data(p)
         order = sorted(
             range(len(pods)),
-            key=lambda i: (
-                -data[pods[i].uid].requests.get(res.CPU, 0),
-                -data[pods[i].uid].requests.get(res.MEMORY, 0),
-                pods[i].metadata.creation_timestamp,
-                pods[i].uid,
-            ),
+            key=lambda i: ffd_sort_key(pods[i], data[pods[i].uid].requests),
         )
 
         from karpenter_tpu.solver import tpu_kernel as K
+        from karpenter_tpu.solver import tpu_runs as KR
 
         tb = self._tables(problem)
         self._typeok = self._pod_typeok(problem, tb)
+        self._upload_pod_tables(problem)
+        gates_ok = _bulk_gates(problem)
+        self._bulk_flags = _bulk_pod_flags(problem, gates_ok)
+        use_runs = bool(self._bulk_flags.any())
+        self.last_used_runs = use_runs  # introspection for tests/bench
 
         # Claim slots: most solves create far fewer claims than pods (the
         # bench mix averages ~5 pods/claim), so start small and grow on the
@@ -156,6 +281,8 @@ class TpuScheduler:
         N = min(_pow2(max(64, (len(pods) + 3) // 4)), _pow2(len(pods)))
         while True:
             st = self._init_state(problem, N)
+            seq = jax.numpy.zeros(N, jax.numpy.int32)
+            next_seq = jax.numpy.zeros((), jax.numpy.int32)
             kinds = np.full(len(pods), K.KIND_FAIL, dtype=np.int32)
             slots = np.full(len(pods), -1, dtype=np.int32)
             pending = list(order)
@@ -165,8 +292,19 @@ class TpuScheduler:
                 if deadline is not None and time_mod.monotonic() > deadline:
                     timed_out = True
                     break
-                xs = self._pod_xs(problem, pending)
-                st, got_kinds, got_slots, got_over = K.solve_scan(tb, st, xs)
+                if use_runs:
+                    xs = self._pod_xs(problem, pending)
+                    rx = self._run_x(problem, pending, xs)
+                    st, seq, next_seq, got_kinds, got_slots, got_over, iters = (
+                        KR.solve_runs(
+                            tb, st, rx, seq, next_seq,
+                            jax.numpy.int32(len(pending)),
+                        )
+                    )
+                    self.last_iters = iters
+                else:
+                    xs = self._pod_xs(problem, pending)
+                    st, got_kinds, got_slots, got_over = K.solve_scan(tb, st, xs)
                 # one batched device->host fetch (the tunnel charges per call)
                 got_kinds, got_slots, got_over = jax.device_get(
                     (got_kinds, got_slots, got_over)
@@ -188,26 +326,67 @@ class TpuScheduler:
 
         return self._decode(problem, st, kinds, slots, timed_out)
 
+    def _run_x(self, p: EncodedProblem, indices: list[int], xs):
+        """Build the run-kernel driver arrays for a pending subsequence."""
+        import jax.numpy as jnp
+
+        from karpenter_tpu.solver import tpu_runs as KR
+
+        n = len(indices)
+        P_pad = xs.valid.shape[0]
+        idx = np.asarray(indices, dtype=np.int64)
+        cls = p.pod_class[idx]
+        is_head = np.ones(P_pad, bool)
+        is_head[1:n] = cls[1:] != cls[:-1]
+        run_rem = np.ones(P_pad, np.int32)
+        # distance to the run's end, inclusive (vectorized over boundaries)
+        heads = np.flatnonzero(is_head[:n])
+        ends = np.zeros(n, np.int64)
+        bounds = np.append(heads[1:], n)
+        ends[heads] = bounds - 1
+        np.maximum.accumulate(ends, out=ends)  # fill within runs
+        run_rem[:n] = (ends - np.arange(n) + 1).astype(np.int32)
+        bulk = np.zeros(P_pad, bool)
+        bulk[:n] = self._bulk_flags[idx]
+        from karpenter_tpu.solver.tpu_problem import TOPO_AFFINITY_H, TOPO_AFFINITY_V
+
+        aff = np.zeros(P_pad, bool)
+        aff[:n] = np.isin(
+            p.ptopo_kind[idx], (TOPO_AFFINITY_V, TOPO_AFFINITY_H)
+        ).any(axis=1)
+        return KR.RunX(
+            x=xs,
+            is_head=jnp.asarray(is_head),
+            bulk=jnp.asarray(bulk),
+            aff=jnp.asarray(aff),
+            run_rem=jnp.asarray(run_rem),
+        )
+
     def _pod_typeok(self, p: EncodedProblem, tb) -> np.ndarray:
         """[P, IW] u32 — per pod, the instance types whose requirements
         intersect the pod's (pairwise screen; the kernel's while_loop stays
-        exact for three-way intersections, offerings, and minValues)."""
+        exact for three-way intersections, offerings, and minValues).
+        Computed per encode-class (pods of a class share rows) and gathered
+        host-side — the device tunnel charges per byte."""
         import jax.numpy as jnp
 
         I = p.num_types
         IW = max(1, (I + 31) // 32)
-        P = len(p.pods)
-        out = np.zeros((P, IW), dtype=np.uint32)
+        cls = p.pod_class
+        NC = int(cls.max()) + 1 if len(cls) else 0
+        reps = np.zeros(NC, dtype=np.int64)
+        reps[cls[::-1]] = np.arange(len(cls) - 1, -1, -1)
+        out_c = np.zeros((NC, IW), dtype=np.uint32)
         CH = 2048
-        for lo in range(0, P, CH):
-            hi = min(lo + CH, P)
+        for lo in range(0, NC, CH):
+            hi = min(lo + CH, NC)
             # pow2-pad chunks so compiled shapes are reused across solves
             pad_to = min(CH, _pow2(hi - lo))
-            idx = np.arange(lo, lo + pad_to) % P
+            idx = reps[np.arange(lo, lo + pad_to) % NC]
             chunk = Reqs(*(jnp.asarray(a[idx]) for a in p.preq))
             got = np.asarray(_typeok_chunk(tb.ireq, tb.va, chunk, iw=IW))
-            out[lo:hi] = got[: hi - lo]
-        return out
+            out_c[lo:hi] = got[: hi - lo]
+        return out_c[cls]
 
     # -- tensor construction --------------------------------------------
 
@@ -307,38 +486,50 @@ class TpuScheduler:
             h_cnt=jnp.asarray(h_cnt),
         )
 
-    def _pod_xs(self, p: EncodedProblem, indices: list[int]):
+    def _upload_pod_tables(self, p: EncodedProblem) -> None:
+        """Ship per-CLASS tables plus per-pod selection rows to the device
+        once per solve; per-round pod batches are then just an index array
+        (the device tunnel charges per byte)."""
         import jax.numpy as jnp
 
-        from karpenter_tpu.solver import tpu_kernel as K
+        cls = p.pod_class
+        NC = int(cls.max()) + 1 if len(cls) else 1
+        reps = np.zeros(NC, dtype=np.int64)
+        reps[cls[::-1]] = np.arange(len(cls) - 1, -1, -1)
+        Gv = max(len(p.vgroups), 1)
+        Gh = max(len(p.hgroups), 1)
+
+        def pad_g(a, G):
+            if a.shape[1] == G:
+                return a
+            return np.zeros((a.shape[0], G), a.dtype)
+
+        self._dev_tables = (
+            Reqs(*(jnp.asarray(a[reps]) for a in p.preq)),
+            jnp.asarray(p.prequests[reps]),
+            jnp.asarray(self._typeok[reps]),
+            jnp.asarray(p.ptol_t[reps]),
+            jnp.asarray(p.ptol_e[reps]),
+            jnp.asarray(p.ptopo_kind[reps]),
+            jnp.asarray(p.ptopo_gid[reps]),
+            jnp.asarray(p.ptopo_sel[reps]),
+            jnp.asarray(cls.astype(np.int32)),
+            jnp.asarray(pad_g(p.psel_v, Gv)),
+            jnp.asarray(pad_g(p.psel_h, Gh)),
+            jnp.asarray(pad_g(p.pinv_h, Gh)),
+            jnp.asarray(pad_g(p.pown_h, Gh)),
+        )
+
+    def _pod_xs(self, p: EncodedProblem, indices: list[int]):
+        import jax.numpy as jnp
 
         n = len(indices)
         P_pad = _pow2(n)
         idx = np.array(indices + [0] * (P_pad - n), dtype=np.int32)
         valid = np.zeros(P_pad, bool)
         valid[:n] = True
-        Gv = max(len(p.vgroups), 1)
-        Gh = max(len(p.hgroups), 1)
-
-        def pad_g(a, G):
-            if a.shape[1] == G:
-                return a[idx]
-            return np.zeros((P_pad, G), a.dtype)
-
-        return K.PodX(
-            preq=Reqs(*(jnp.asarray(a[idx]) for a in p.preq)),
-            prequests=jnp.asarray(p.prequests[idx]),
-            typeok=jnp.asarray(self._typeok[idx]),
-            tol_t=jnp.asarray(p.ptol_t[idx]),
-            tol_e=jnp.asarray(p.ptol_e[idx]),
-            topo_kind=jnp.asarray(p.ptopo_kind[idx]),
-            topo_gid=jnp.asarray(p.ptopo_gid[idx]),
-            topo_sel=jnp.asarray(p.ptopo_sel[idx]),
-            sel_v=jnp.asarray(pad_g(p.psel_v, Gv)),
-            sel_h=jnp.asarray(pad_g(p.psel_h, Gh)),
-            inv_h=jnp.asarray(pad_g(p.pinv_h, Gh)),
-            own_h=jnp.asarray(pad_g(p.pown_h, Gh)),
-            valid=jnp.asarray(valid),
+        return _gather_xs(
+            self._dev_tables, jnp.asarray(idx), jnp.asarray(valid)
         )
 
     # -- decoding --------------------------------------------------------
@@ -357,8 +548,15 @@ class TpuScheduler:
 
         vocab, table = p.vocab, p.table
         scheduler = self.oracle
-        # one batched device->host fetch of everything decode reads
-        st = jax.device_get(st)
+        # one batched device->host fetch of ONLY the fields decode reads
+        # (the tunnel charges per byte; count/rank/topology stay behind)
+        st = jax.device_get(
+            (
+                st.n_claims, st.creq, st.crequests, st.alive, st.tmpl,
+                st.eavail, st.ereq, st.v_cnt, st.h_cnt,
+            )
+        )
+        st = _DecodeView(*st)
         n_claims = int(st.n_claims)
         creq = Reqs(*(np.asarray(a) for a in st.creq))
         crequests = np.asarray(st.crequests)
@@ -374,18 +572,42 @@ class TpuScheduler:
                 if id(it) not in type_idx:
                     type_idx[id(it)] = len(type_idx)
 
+        # unpack every claim's surviving-type bits in one vectorized pass
+        # (a per-claim per-type Python loop dominates decode at scale)
+        alive_bits = np.unpackbits(
+            np.ascontiguousarray(alive[:n_claims]).astype("<u4").view(np.uint8),
+            axis=-1,
+            bitorder="little",
+        )
+        ordered_types = [None] * len(type_idx)
+        for it_id, i in type_idx.items():
+            ordered_types[i] = it_id
+        types_by_id = {}
+        for nct in scheduler.templates:
+            for it in nct.instance_type_options:
+                types_by_id[id(it)] = it
+
+        # many claims share identical requirement rows (same class/template/
+        # domain) — decode each distinct row once and copy
+        row_cache: dict[bytes, Requirements] = {}
+
+        def decode_cached(slot: int) -> Requirements:
+            key = b"".join(np.ascontiguousarray(a[slot]).tobytes() for a in creq)
+            got = row_cache.get(key)
+            if got is None:
+                got = decode_row(vocab, creq.row(slot))
+                row_cache[key] = got
+            return got.copy()
+
         claims: list[SchedulingNodeClaim] = []
         for slot in range(n_claims):
             nct = scheduler.templates[int(tmpl[slot])]
             claim = SchedulingNodeClaim.__new__(SchedulingNodeClaim)
             claim.template = nct
             claim.hostname = f"hostname-placeholder-{next(_claim_seq):04d}"
-            claim.requirements = decode_row(vocab, creq.row(slot))
-            live = [
-                it
-                for it in nct.instance_type_options
-                if (alive[slot][type_idx[id(it)] // 32] >> (type_idx[id(it)] % 32)) & 1
-            ]
+            claim.requirements = decode_cached(slot)
+            live_idx = np.flatnonzero(alive_bits[slot])
+            live = [types_by_id[ordered_types[i]] for i in live_idx]
             claim.instance_type_options = InstanceTypes(live)
             claim.requests = table.decode(crequests[slot])
             claim.daemon_resources = scheduler.daemon_overhead[nct]
@@ -420,6 +642,27 @@ class TpuScheduler:
                 pod_errors[pod.uid] = self._error_for(pod)
 
         scheduler.new_node_claims = claims
+
+        # sync the host Topology's domain counts from the device state so a
+        # continuation solve (per-pod hybrid partitioning) and any later
+        # host-side simulation see the TPU-recorded placements as truth
+        v_cnt = np.asarray(st.v_cnt)
+        h_cnt = np.asarray(st.h_cnt)
+        for g, vg in enumerate(p.vgroups):
+            vals = vocab.values[vg.kid]
+            tg = vg.group
+            for vid, val in enumerate(vals):
+                if p.v_reg[g, vid] or v_cnt[g, vid]:
+                    tg.domains[val] = int(v_cnt[g, vid])
+        hostnames = [n.view.hostname for n in scheduler.existing_nodes] + [
+            c.hostname for c in claims
+        ]
+        for g, hg in enumerate(p.hgroups):
+            tg = hg.group
+            for slot, hn in enumerate(hostnames):
+                c = int(h_cnt[g, slot])
+                if c:
+                    tg.domains[hn] = c
         return Results(
             new_node_claims=claims,
             existing_nodes=scheduler.existing_nodes,

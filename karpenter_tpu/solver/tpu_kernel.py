@@ -369,8 +369,17 @@ def _min_values_ok(final: Reqs, final_i: jax.Array, tb: Tables) -> jax.Array:
         tb.ireq.other[..., tb.va.word2key], tb.ireq.exmask, tb.ireq.mask
     )
     src = jnp.where(tb.ireq.defined[..., tb.va.word2key], src, jnp.uint32(0))
-    union = jnp.where(final_i[:, None], src, jnp.uint32(0))
-    union = jax.lax.reduce(union, jnp.uint32(0), jax.lax.bitwise_or, (0,))
+    # bitwise-or across the type axis, expressed as unpack -> any -> repack:
+    # an any-reduce lowers to a collective when the type axis is sharded
+    # (a raw u32-or reduction does not)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = ((src[..., None] >> shifts) & jnp.uint32(1)).astype(bool)  # [I, TW, 32]
+    union_bits = jnp.any(bits & final_i[:, None, None], axis=0)  # [TW, 32]
+    union = jnp.sum(
+        union_bits.astype(jnp.uint32) * (jnp.uint32(1) << shifts)[None, :],
+        axis=-1,
+        dtype=jnp.uint32,
+    )
     counts = seg_popcount(union, tb.va)
     return jnp.all((final.minv < 0) | (counts >= final.minv))
 

@@ -40,6 +40,9 @@ class UnsupportedBySolver(Exception):
     """Problem uses a feature outside the tensor encoding; use the oracle."""
 
 
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+
 # topology-slot kinds in the per-pod constraint table
 TOPO_NONE = 0
 TOPO_SPREAD_V = 1  # zone-family (vocab-key) spread
@@ -136,6 +139,7 @@ class EncodedProblem:
 
     # per-pod tables (built per solve() call)
     pods: list[Pod] = field(default_factory=list)
+    pod_class: Optional[np.ndarray] = None  # [P] i32 — encode-class index
     preq: Optional[Reqs] = None  # [P]
     prequests: Optional[np.ndarray] = None  # [P, R] i32
     ptol_t: Optional[np.ndarray] = None  # [P, T] bool tolerates template taints
@@ -154,30 +158,44 @@ def _gate(cond: bool, why: str) -> None:
         raise UnsupportedBySolver(why)
 
 
-def _check_pod_supported(pod: Pod) -> None:
-    """Features the kernel doesn't encode yet -> oracle fallback. The
+def pod_unsupported_reason(pod: Pod) -> Optional[str]:
+    """Why the kernel can't encode this pod (None = fully supported). The
     relaxation ladder (preferences.go:38) is the big one: it mutates pod
-    specs mid-solve, which would force host round-trips per relaxation."""
-    _gate(bool(pod.host_ports), "pod host ports")
-    _gate(bool(pod.volume_claims), "pod volume claims")
-    _gate(bool(pod.pod_affinity_preferred), "preferred pod affinity (relaxable)")
-    _gate(bool(pod.pod_anti_affinity_preferred), "preferred pod anti-affinity (relaxable)")
+    specs mid-solve, which would force host round-trips per relaxation.
+    The hybrid dispatch partitions per pod on this predicate — one
+    relaxable pod no longer drags a whole batch to the oracle."""
+    if pod.host_ports:
+        return "pod host ports"
+    if pod.volume_claims:
+        return "pod volume claims"
+    if pod.pod_affinity_preferred:
+        return "preferred pod affinity (relaxable)"
+    if pod.pod_anti_affinity_preferred:
+        return "preferred pod anti-affinity (relaxable)"
     na = pod.node_affinity
     if na is not None:
-        _gate(bool(na.preferred), "preferred node affinity (relaxable)")
-        _gate(len(na.required_terms) > 1, "multiple required node-affinity terms (relaxable)")
-    _gate(
-        any(t.when_unsatisfiable != "DoNotSchedule" for t in pod.topology_spread_constraints),
-        "ScheduleAnyway topology spread (relaxable)",
-    )
-    _gate(
-        well_known.HOSTNAME_LABEL_KEY in pod.node_selector,
-        "hostname node selector",
-    )
+        if na.preferred:
+            return "preferred node affinity (relaxable)"
+        if len(na.required_terms) > 1:
+            return "multiple required node-affinity terms (relaxable)"
+    if any(
+        t.when_unsatisfiable != "DoNotSchedule"
+        for t in pod.topology_spread_constraints
+    ):
+        return "ScheduleAnyway topology spread (relaxable)"
+    if well_known.HOSTNAME_LABEL_KEY in pod.node_selector:
+        return "hostname node selector"
     if na is not None:
         for term in na.required_terms:
             for e in term.match_expressions:
-                _gate(e.key == well_known.HOSTNAME_LABEL_KEY, "hostname affinity term")
+                if e.key == well_known.HOSTNAME_LABEL_KEY:
+                    return "hostname affinity term"
+    return None
+
+
+def _check_pod_supported(pod: Pod) -> None:
+    reason = pod_unsupported_reason(pod)
+    _gate(reason is not None, reason or "")
 
 
 def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
@@ -482,6 +500,13 @@ def _clip_skew(skew: int) -> int:
 def _encode_pods(
     p: EncodedProblem, pods: list[Pod], group_vid: dict[int, tuple[str, int]]
 ) -> None:
+    """Per-pod tensors, encoded once per *scheduling class* and broadcast:
+    pods sharing a class signature + request vector get identical rows
+    (solver/ordering.py), which cuts the Python encode cost from O(pods)
+    to O(classes) — the host must stay off the critical path for the run
+    kernel's throughput."""
+    from karpenter_tpu.solver.ordering import pod_encode_class
+
     vocab, table, scheduler = p.vocab, p.table, p.scheduler
     topo = scheduler.topology
     P = len(pods)
@@ -489,19 +514,75 @@ def _encode_pods(
     Gv, Gh = len(p.vgroups), len(p.hgroups)
     p.pods = pods
 
-    preqs = []
-    p.prequests = np.zeros((P, table.num_resources), dtype=np.int32)
+    # ---- selection rows (per pod; labels are outside the class) ---------
+    sel_cache: dict[tuple, tuple] = {}
+
+    def selects_row(pod: Pod) -> tuple[np.ndarray, np.ndarray]:
+        skey = (pod.namespace, tuple(sorted(pod.metadata.labels.items())))
+        got = sel_cache.get(skey)
+        if got is None:
+            vrow = np.array(
+                [vg.group.selects(pod) for vg in p.vgroups], dtype=bool
+            )
+            hrow = np.array(
+                [hg.group.selects(pod) for hg in p.hgroups], dtype=bool
+            )
+            got = (vrow, hrow)
+            sel_cache[skey] = got
+        return got
+
+    p.psel_v = np.zeros((P, Gv), dtype=bool)
+    p.psel_h = np.zeros((P, Gh), dtype=bool)
+    p.pinv_h = np.zeros((P, Gh), dtype=bool)
+    p.pown_h = np.zeros((P, Gh), dtype=bool)
+    inverse_gs = [g for g, hg in enumerate(p.hgroups) if hg.inverse]
     for i, pod in enumerate(pods):
+        vrow, hrow = selects_row(pod)
+        p.psel_v[i] = vrow
+        p.psel_h[i] = hrow
+        for g in inverse_gs:
+            # inverse groups act as anti-affinity on any pod they select
+            # (topology.go:528) and record for their owners
+            p.pinv_h[i, g] = hrow[g]
+            p.pown_h[i, g] = p.hgroups[g].group.is_owned_by(pod.uid)
+
+    # ---- class dedup ----------------------------------------------------
+    # inverse-anti selection feeds per-pod FEASIBILITY (kernel inv_bad) and
+    # ownership feeds in-run budget dynamics, so both split classes even
+    # though plain selection rows don't
+    class_of: dict[tuple, int] = {}
+    cls = np.zeros(P, dtype=np.int32)
+    reps: list[int] = []
+    for i, pod in enumerate(pods):
+        key = pod_encode_class(pod, pod.requests) + (
+            p.pinv_h[i].tobytes(),
+            p.pown_h[i].tobytes(),
+        )
+        c = class_of.get(key)
+        if c is None:
+            c = len(reps)
+            class_of[key] = c
+            reps.append(i)
+        cls[i] = c
+    NC = len(reps)
+    p.pod_class = cls
+
+    preqs = []
+    prequests_c = np.zeros((NC, table.num_resources), dtype=np.int32)
+    for c, i in enumerate(reps):
+        pod = pods[i]
         reqs = Requirements.from_pod(pod)
         reqs.pop(well_known.HOSTNAME_LABEL_KEY)
         preqs.append(reqs)
-        p.prequests[i] = table.encode(res.requests_for_pods([pod]))
+        prequests_c[c] = table.encode(res.requests_for_pods([pod]))
     try:
-        p.preq = encode_requirements(vocab, preqs)
+        preq_c = encode_requirements(vocab, preqs)
     except UnsupportedProblem as e:
         raise UnsupportedBySolver(str(e)) from e
+    p.preq = Reqs(*(a[cls] for a in preq_c))
+    p.prequests = prequests_c[cls]
 
-    # taint toleration (static per pod x template/node)
+    # taint toleration (static per class x template/node)
     tol_cache: dict[tuple, bool] = {}
 
     def tolerates(taints, pod) -> bool:
@@ -517,20 +598,24 @@ def _encode_pods(
             tol_cache[key] = got
         return got
 
-    p.ptol_t = np.zeros((P, T), dtype=bool)
+    ptol_t_c = np.zeros((NC, T), dtype=bool)
     for t, nct in enumerate(scheduler.templates):
-        for i, pod in enumerate(pods):
-            p.ptol_t[i, t] = tolerates(nct.taints, pod)
-    p.ptol_e = np.zeros((P, E), dtype=bool)
+        for c, i in enumerate(reps):
+            ptol_t_c[c, t] = tolerates(nct.taints, pods[i])
+    p.ptol_t = ptol_t_c[cls]
+    ptol_e_c = np.zeros((NC, E), dtype=bool)
     for e, node in enumerate(scheduler.existing_nodes):
-        for i, pod in enumerate(pods):
-            p.ptol_e[i, e] = tolerates(node.cached_taints, pod)
+        for c, i in enumerate(reps):
+            ptol_e_c[c, e] = tolerates(node.cached_taints, pods[i])
+    p.ptol_e = ptol_e_c[cls]
 
     # host-port conflicts are gated off; see _check_pod_supported
-    for pod in pods:
-        assert not get_host_ports(pod)
+    for i in reps:
+        assert not get_host_ports(pods[i])
 
-    # topology ownership tables
+    # topology ownership tables (same groups for every pod of a class: the
+    # Topology hashes groups by constraint spec, which the class signature
+    # covers)
     kind_of = {
         ("v", TopologyType.SPREAD): TOPO_SPREAD_V,
         ("v", TopologyType.POD_AFFINITY): TOPO_AFFINITY_V,
@@ -543,49 +628,22 @@ def _encode_pods(
     for tg in topo.topology_groups.values():
         for uid in tg.owners:
             owned_by_uid.setdefault(uid, []).append(tg)
-    C = max([len(owned_by_uid.get(pod.uid, ())) for pod in pods], default=0)
+    C = max([len(owned_by_uid.get(pods[i].uid, ())) for i in reps], default=0)
     C = max(1, C)
     _gate(C > MAX_OWNED_TOPOLOGIES, "pod owns too many topology constraints")
-    p.ptopo_kind = np.zeros((P, C), dtype=np.int32)
-    p.ptopo_gid = np.zeros((P, C), dtype=np.int32)
-    p.ptopo_sel = np.zeros((P, C), dtype=bool)
-    p.psel_v = np.zeros((P, Gv), dtype=bool)
-    p.psel_h = np.zeros((P, Gh), dtype=bool)
-    p.pinv_h = np.zeros((P, Gh), dtype=bool)
-    p.pown_h = np.zeros((P, Gh), dtype=bool)
-
-    # selects() memoized by (namespace, labels fingerprint)
-    sel_cache: dict[tuple, np.ndarray] = {}
-
-    def selects_row(pod: Pod) -> tuple[np.ndarray, np.ndarray]:
-        key = (pod.namespace, tuple(sorted(pod.metadata.labels.items())))
-        got = sel_cache.get(key)
-        if got is None:
-            vrow = np.array(
-                [vg.group.selects(pod) for vg in p.vgroups], dtype=bool
-            )
-            hrow = np.array(
-                [hg.group.selects(pod) for hg in p.hgroups], dtype=bool
-            )
-            got = (vrow, hrow)
-            sel_cache[key] = got
-        return got
-
-    for i, pod in enumerate(pods):
+    ptopo_kind_c = np.zeros((NC, C), dtype=np.int32)
+    ptopo_gid_c = np.zeros((NC, C), dtype=np.int32)
+    ptopo_sel_c = np.zeros((NC, C), dtype=bool)
+    for c, i in enumerate(reps):
+        pod = pods[i]
         vrow, hrow = selects_row(pod)
-        p.psel_v[i] = vrow
-        p.psel_h[i] = hrow
         slot = 0
         for tg in owned_by_uid.get(pod.uid, ()):
             fam, gid = group_vid[id(tg)]
-            p.ptopo_kind[i, slot] = kind_of[(fam, tg.type)]
-            p.ptopo_gid[i, slot] = gid
-            p.ptopo_sel[i, slot] = vrow[gid] if fam == "v" else hrow[gid]
+            ptopo_kind_c[c, slot] = kind_of[(fam, tg.type)]
+            ptopo_gid_c[c, slot] = gid
+            ptopo_sel_c[c, slot] = vrow[gid] if fam == "v" else hrow[gid]
             slot += 1
-        for g, hg in enumerate(p.hgroups):
-            if not hg.inverse:
-                continue
-            # inverse groups act as anti-affinity on any pod they select
-            # (topology.go:528) and record for their owners
-            p.pinv_h[i, g] = hrow[g]
-            p.pown_h[i, g] = hg.group.is_owned_by(pod.uid)
+    p.ptopo_kind = ptopo_kind_c[cls]
+    p.ptopo_gid = ptopo_gid_c[cls]
+    p.ptopo_sel = ptopo_sel_c[cls]

@@ -1,0 +1,714 @@
+"""The run kernel: bulk-commits whole runs of identical pods per device step.
+
+The shared FFD comparator (solver/ordering.py) makes pods of the same
+*scheduling class* contiguous, so the solve order is a sequence of runs of
+pods whose per-pod decisions are the same function of solver state. This
+kernel walks the pod sequence with a `lax.while_loop`:
+
+- the first pod of a run (and every pod of a non-bulkable class) executes
+  the exact per-pod step (`tpu_kernel._step`) — bit-identical to the
+  oracle's decision by construction;
+- after a bulkable run's head commits, a *run cache* is built once:
+  per-target viability plus exact pod-unit capacities — deliberately tiny
+  (a few KB), because the loop carry is copied every iteration on TPU and
+  carry bytes are the dominant per-step cost. Final requirement rows are
+  re-derived per commit window instead of cached per claim: the class's
+  topology tightening is static for the run (gates below), so evaluating
+  it for <= W chosen targets costs a few small ops;
+- the remaining pods of the run then commit in bulk phases, many pods per
+  device step: existing nodes first-fill by cumulative capacity, in-flight
+  claims absorb one pod per claim per *count level* (the reference's
+  ascending-pod-count round-robin, scheduler.go:499), a lone feasible claim
+  absorbs a whole window, and fresh claims fill to their exact pod capacity
+  in one step each.
+
+Bulkability gates (everything else falls back to the exact per-pod step,
+so unsupported shapes cost speed, never correctness):
+- the class owns no hostname-family constraints and is not selected by any
+  inverse anti-affinity group (their viability reads per-slot counts that
+  change on every commit);
+- its zone-family constraints are self-stable: pod-affinity (the positive
+  domain set cannot change mid-run — commits only land inside it), or
+  spread/anti-affinity whose group does NOT select the pod (the counts the
+  constraint reads never move during the run);
+- problem-level: no minValues anywhere, no nodepool limits, every instance
+  type's requirement sets are single-valued or whole-vocabulary per key
+  (pairwise screens are then exact three-way), and offerings decompose per
+  key (zone×capacity-type coverage is a cartesian product) — computed
+  host-side in solver/tpu.py and folded into the per-pod bulk flag.
+
+Claim ordering uses an event-sequence key instead of the rank vector:
+claims sort by (pod count asc, then creation order asc within count 1,
+promotion recency desc within count >= 2) — provably the same total order
+the reference's stable re-sort + front-of-block moves produce
+(tpu_kernel._rank_after_increment/_rank_after_create). The rank vector a
+`_step` call expects is derived from this key on demand.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu.ops.encode import Reqs
+from karpenter_tpu.ops.kernels import compat, intersect
+from karpenter_tpu.solver import tpu_kernel as K
+from karpenter_tpu.solver.tpu_kernel import (
+    INF_I,
+    KIND_CLAIM,
+    KIND_EXISTING,
+    KIND_FAIL,
+    KIND_NEW,
+    PodX,
+    State,
+    Tables,
+    _apply_tighten,
+    _broadcast_row,
+    _eval_topology,
+    _pack,
+    _row,
+    _set_row,
+    _topo_nonempty_ok,
+    _type_filter,
+    _unpack,
+)
+
+# bulk window: max pods committed per device step. Window work is O(W·I),
+# so keep it modest — runs longer than W just take extra (cheap) steps.
+W = 64
+# seq-key building block; counts and seqs both stay far below this
+_SEQ_LIM = 1 << 21
+
+# bulk dispatch cases
+_CASE_EXISTING = 0
+_CASE_LEVEL = 1
+_CASE_SOLO = 2
+_CASE_NEW = 3
+_CASE_FAIL = 4
+
+
+class RunX(NamedTuple):
+    """Per-pod driver inputs beyond PodX."""
+
+    x: PodX  # [P] rows
+    is_head: jax.Array  # [P] bool — first pod of its run
+    bulk: jax.Array  # [P] bool — class is bulkable (incl. problem gates)
+    # class owns a pod-affinity constraint: its head must commit through the
+    # exact step BEFORE the cache builds (a bootstrap commit changes the
+    # positive-domain set the rest of the run is confined to)
+    aff: jax.Array  # [P] bool
+    run_rem: jax.Array  # [P] i32 — pods from i to its run's end, inclusive
+
+
+class RunCache(NamedTuple):
+    """Static-per-run products, built once after the head pod commits.
+    Kept small: the whole cache rides the loop carry."""
+
+    active: jax.Array  # scalar bool — bulk mode on for the current run
+    ok_c: jax.Array  # [N] bool — compat+tol+topology-viable (pre-capacity)
+    excl_c: jax.Array  # [N] bool — exact-verify failures (permanent per run)
+    ok_e: jax.Array  # [E] bool
+    cape: jax.Array  # [E] i32 — exact pod-units remaining
+    ok_t: jax.Array  # [T] bool — fully viable (incl. topology, static)
+    final_t: Reqs  # [T] — rows a fresh claim writes (T is tiny)
+    alive_t: jax.Array  # [T, IW] u32 — surviving types for a fresh claim
+    capt: jax.Array  # [T] i32 — exact pod-units of a fresh claim
+
+
+def _seq_key(count, seq, active):
+    """The claim ordering key (module docstring). Smaller = earlier."""
+    within = jnp.where(count == 1, seq, _SEQ_LIM - 1 - seq)
+    return jnp.where(active, count * _SEQ_LIM + within, jnp.iinfo(jnp.int32).max)
+
+
+def _derive_rank(st: State, seq) -> jax.Array:
+    """Rank vector for a `_step` call: position of each active claim under
+    the seq-key order."""
+    key = _seq_key(st.count, seq, st.active)
+    order = jnp.argsort(key)
+    rank = jnp.zeros_like(seq).at[order].set(jnp.arange(seq.shape[0], dtype=seq.dtype))
+    return rank
+
+
+def _pod_units(avail, preq):
+    """Exact pod-units a resource vector can absorb: min over requested
+    dims of floor(avail/req); 0 if any dim is negative."""
+    pos = preq > 0
+    per = jnp.where(pos, avail // jnp.maximum(preq, 1), INF_I)
+    units = jnp.min(per, axis=-1)
+    return jnp.where(jnp.all(avail >= 0, axis=-1), jnp.maximum(units, 0), 0)
+
+
+def _rows_at(r: Reqs, idx) -> Reqs:
+    return Reqs(*(a[idx] for a in r))
+
+
+def _set_rows(dst: Reqs, idx, rows: Reqs, pred) -> Reqs:
+    """Scatter rows into dst at idx where pred; out-of-bounds writes (the
+    masked-off window tail) are dropped by XLA scatter semantics."""
+    n = dst.mask.shape[0]
+    safe = jnp.where(pred, idx, n)
+    return Reqs(*(a.at[safe].set(r) for a, r in zip(dst, rows)))
+
+
+# ---------------------------------------------------------------------------
+# per-window final-row derivation (topology is static for bulkable runs)
+
+
+def _final_claim_rows(tb: Tables, st: State, x: PodX, slots):
+    """Re-derive merged+tightened rows for a window of claim slots."""
+    E = st.eavail.shape[0]
+    creq_rows = _rows_at(st.creq, slots)
+    merged = intersect(creq_rows, _broadcast_row(x.preq, slots.shape[0]), tb.va)
+    te = _eval_topology(
+        merged, st.h_cnt[:, E + slots], jnp.any(st.h_cnt > 0, axis=-1), x, st, tb
+    )
+    return _apply_tighten(merged, te.tight, te.touched, tb.va)
+
+
+def _final_existing_rows(tb: Tables, st: State, x: PodX, slots):
+    ereq_rows = _rows_at(st.ereq, slots)
+    merged = intersect(ereq_rows, _broadcast_row(x.preq, slots.shape[0]), tb.va)
+    te = _eval_topology(
+        merged, st.h_cnt[:, slots], jnp.any(st.h_cnt > 0, axis=-1), x, st, tb
+    )
+    return _apply_tighten(merged, te.tight, te.touched, tb.va)
+
+
+# ---------------------------------------------------------------------------
+# cache construction (after the head pod of a bulkable run commits)
+
+
+def _build_cache(tb: Tables, st: State, x: PodX) -> RunCache:
+    E = st.eavail.shape[0]
+    N = st.active.shape[0]
+    T = tb.tdaemon.shape[0]
+    I = tb.ialloc.shape[0]
+
+    nonempty_h = jnp.any(st.h_cnt > 0, axis=-1)
+
+    # claims
+    merged_c = intersect(st.creq, _broadcast_row(x.preq, N), tb.va)
+    compat_c = compat(st.creq, _broadcast_row(x.preq, N), tb.va, True)
+    te_c = _eval_topology(merged_c, st.h_cnt[:, E:], nonempty_h, x, st, tb)
+    final_c = _apply_tighten(merged_c, te_c.tight, te_c.touched, tb.va)
+    ok_c = (
+        x.tol_t[jnp.clip(st.tmpl, 0, max(T - 1, 0))]
+        & compat_c
+        & te_c.viable
+        & _topo_nonempty_ok(final_c, te_c.touched, tb.va)
+    )
+
+    # existing nodes
+    if E > 0:
+        merged_e = intersect(st.ereq, _broadcast_row(x.preq, E), tb.va)
+        compat_e = compat(st.ereq, _broadcast_row(x.preq, E), tb.va, False)
+        te_e = _eval_topology(merged_e, st.h_cnt[:, :E], nonempty_h, x, st, tb)
+        final_e = _apply_tighten(merged_e, te_e.tight, te_e.touched, tb.va)
+        ok_e = (
+            x.tol_e
+            & compat_e
+            & te_e.viable
+            & _topo_nonempty_ok(final_e, te_e.touched, tb.va)
+        )
+        cape = _pod_units(st.eavail, x.prequests[None, :])
+    else:
+        ok_e = jnp.zeros((E,), bool)
+        cape = jnp.zeros((E,), jnp.int32)
+
+    # templates (full ladder; topology is static for bulkable classes)
+    merged_t = intersect(tb.treq, _broadcast_row(x.preq, T), tb.va)
+    compat_t = compat(tb.treq, _broadcast_row(x.preq, T), tb.va, True)
+    te_t = _eval_topology(
+        merged_t,
+        jnp.zeros((st.h_cnt.shape[0], T), st.h_cnt.dtype),
+        nonempty_h,
+        x,
+        st,
+        tb,
+    )
+    final_t = _apply_tighten(merged_t, te_t.tight, te_t.touched, tb.va)
+    tmember = jax.vmap(lambda w: _unpack(w, I))(tb.ttypes)  # [T, I]
+    totals = tb.tdaemon + x.prequests
+    t_final_i = jax.vmap(
+        lambda f, a, tot: _type_filter(f, a, tot, tb), in_axes=(0, 0, 0)
+    )(final_t, tmember, totals)
+    per_type = jax.vmap(
+        lambda daemon, fi: jnp.where(
+            fi, _pod_units(tb.ialloc - daemon[None, :], x.prequests[None, :]), 0
+        )
+    )(tb.tdaemon, t_final_i)  # [T, I]
+    capt = jnp.max(per_type, axis=-1, initial=0)
+    ok_t = (
+        compat_t
+        & x.tol_t
+        & te_t.viable
+        & _topo_nonempty_ok(final_t, te_t.touched, tb.va)
+        & jnp.any(t_final_i, axis=-1)
+    )
+
+    return RunCache(
+        active=jnp.ones((), bool),
+        ok_c=ok_c,
+        excl_c=jnp.zeros((N,), bool),
+        ok_e=ok_e,
+        cape=cape,
+        ok_t=ok_t,
+        final_t=final_t,
+        alive_t=jax.vmap(lambda b: _pack(b, st.alive.shape[1]))(t_final_i),
+        capt=capt,
+    )
+
+
+def _empty_cache(tb: Tables, st: State) -> RunCache:
+    E = st.eavail.shape[0]
+    N = st.active.shape[0]
+    T = tb.tdaemon.shape[0]
+    treq0 = jax.tree.map(lambda a: jnp.zeros((T,) + a.shape[1:], a.dtype), tb.treq)
+    return RunCache(
+        active=jnp.zeros((), bool),
+        ok_c=jnp.zeros((N,), bool),
+        excl_c=jnp.zeros((N,), bool),
+        ok_e=jnp.zeros((E,), bool),
+        cape=jnp.zeros((E,), jnp.int32),
+        ok_t=jnp.zeros((T,), bool),
+        final_t=treq0,
+        alive_t=jnp.zeros((T, st.alive.shape[1]), jnp.uint32),
+        capt=jnp.zeros((T,), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# bulk record: the topology Record for a window of commits
+
+
+def _record_window(st, tb, finals: Reqs, slots, preds, selv, selh, ownh, allow_wk):
+    """Batched tpu_kernel._record over a [Wb] window. finals are per-commit
+    final rows; slots are global (existing e, or E + claim slot)."""
+    segbits = jax.vmap(lambda m: K._gather_bits(m, tb.v_word, tb.v_bit))(
+        finals.mask
+    )  # [Wb, Gv, VMAX]
+    exbits = jax.vmap(lambda m: K._gather_bits(m, tb.v_word, tb.v_bit))(finals.exmask)
+    other_k = finals.other[:, jnp.clip(tb.v_kid, 0, None)]  # [Wb, Gv]
+    popc = jnp.sum(segbits.astype(jnp.int32), axis=-1)
+    single = (popc == 1) & ~other_k
+    filt_ok = jax.vmap(lambda f: K._eval_filters(tb.v_filt, f, tb, allow_wk))(finals)
+    add = jnp.where(
+        tb.v_anti[None, :, None],
+        jnp.where(other_k[..., None], exbits, segbits),
+        segbits & single[..., None],
+    )
+    gate_v = (preds[:, None] & selv & filt_ok)[..., None]
+    v_cnt = st.v_cnt + jnp.sum((add & gate_v).astype(jnp.int32), axis=0)
+
+    filt_ok_h = jax.vmap(lambda f: K._eval_filters(tb.h_filt, f, tb, allow_wk))(finals)
+    contrib = jnp.where(tb.h_inverse[None, :], ownh, selh & filt_ok_h)  # [Wb, Gh]
+    vals = (preds[:, None] & contrib).astype(jnp.int32)  # [Wb, Gh]
+    h_cnt = st.h_cnt.at[:, slots].add(vals.T)
+    return v_cnt, h_cnt
+
+
+# ---------------------------------------------------------------------------
+# the driver
+
+
+@functools.partial(jax.jit, static_argnames=())
+def solve_runs(tb: Tables, st: State, rx: RunX, seq, next_seq, n_valid):
+    """Returns (state, seq, next_seq, kinds[P], slots[P], overflowed, iters).
+    Pods at index >= n_valid are shape padding and are never visited."""
+    P = rx.is_head.shape[0]
+    N = st.active.shape[0]
+    E = st.eavail.shape[0]
+    I = tb.ialloc.shape[0]
+    IW = st.alive.shape[1]
+
+    kinds0 = jnp.full(P + W, KIND_FAIL, jnp.int32)
+    slots0 = jnp.full(P + W, -1, jnp.int32)
+
+    def xrow(i) -> PodX:
+        return jax.tree.map(lambda a: a[i], rx.x)
+
+    def window_rows(ptr):
+        idx = jnp.clip(ptr + jnp.arange(W), 0, P - 1)
+        return rx.x.sel_v[idx], rx.x.sel_h[idx], rx.x.own_h[idx]
+
+    def write_window(buf, ptr, vals):
+        return jax.lax.dynamic_update_slice(buf, vals, (ptr,))
+
+    # -- exact per-pod path (every run head; all pods of non-bulk classes)
+    def single_step(carry):
+        st, rc, seq, nseq, ptr, kinds, slots, over = carry
+        x = xrow(ptr)
+        # the seq key is a monotone transform of the rank order, and _step
+        # only ever uses rank for min-selection (its rank updates are
+        # discarded here), so the key substitutes directly — no sort
+        st_in = st._replace(rank=_seq_key(st.count, seq, st.active))
+        st2, (kind, slot, oflow) = K._step(tb, st_in, x)
+        joined = kind == KIND_CLAIM
+        created = kind == KIND_NEW
+        upd = joined | created
+        sslot = jnp.where(joined, slot, st.n_claims)
+        seq = seq.at[sslot].set(jnp.where(upd, nseq, seq[sslot]))
+        nseq = nseq + upd.astype(jnp.int32)
+        kinds = kinds.at[ptr].set(kind)
+        slots = slots.at[ptr].set(slot)
+        build = rx.bulk[ptr] & (rx.run_rem[ptr] > 1) & x.valid & ~oflow
+        rc = jax.lax.cond(
+            build,
+            lambda: _build_cache(tb, st2, x),
+            lambda: rc._replace(active=jnp.zeros((), bool)),
+        )
+        return st2, rc, seq, nseq, ptr + 1, kinds, slots, over | oflow
+
+    # -- bulk phases ------------------------------------------------------
+
+    def bulk_step(carry):
+        st, rc, seq, nseq, ptr, kinds, slots, over = carry
+        x = xrow(ptr)
+        rem = rx.run_rem[ptr]
+        selv, selh, ownh = window_rows(ptr)
+        jW = jnp.arange(W)
+
+        # dynamic hostname budgets: spread-h / anti-h constraints that
+        # select the pod consume one slot-unit per commit (skew - count,
+        # and 1 - count respectively); everything else about hostname
+        # topology is static within the run and lives in the head's ok_*
+        def h_budgets(offs, n):
+            bud = jnp.full((n,), INF_I, jnp.int32)
+            for c in range(x.topo_kind.shape[0]):
+                kind = x.topo_kind[c]
+                gid = jnp.clip(x.topo_gid[c], 0, st.h_cnt.shape[0] - 1)
+                dyn = x.topo_sel[c] & (
+                    (kind == K.TOPO_SPREAD_H) | (kind == K.TOPO_ANTI_H)
+                )
+                cap0 = jnp.where(kind == K.TOPO_SPREAD_H, tb.h_skew[gid], 1)
+                cnt = st.h_cnt[gid, offs + jnp.arange(n)]
+                bud = jnp.minimum(bud, jnp.where(dyn, cap0 - cnt, INF_I))
+            return bud
+
+        def h_budget_fresh():
+            bud = jnp.full((), INF_I, jnp.int32)
+            for c in range(x.topo_kind.shape[0]):
+                kind = x.topo_kind[c]
+                gid = jnp.clip(x.topo_gid[c], 0, st.h_cnt.shape[0] - 1)
+                dyn = x.topo_sel[c] & (
+                    (kind == K.TOPO_SPREAD_H) | (kind == K.TOPO_ANTI_H)
+                )
+                cap0 = jnp.where(kind == K.TOPO_SPREAD_H, tb.h_skew[gid], 1)
+                bud = jnp.minimum(bud, jnp.where(dyn, cap0, INF_I))
+            return bud
+
+        hb_c = h_budgets(E, N)
+        hb_fresh = h_budget_fresh()
+        feas_e = rc.ok_e & (rc.cape > 0) & ((h_budgets(0, E) > 0) if E > 0 else True)
+        screen_fits = jnp.all(st.crequests + x.prequests <= st.cmax_alloc, axis=-1)
+        screen_types = jnp.any((st.alive & x.typeok) != 0, axis=-1)
+        feas_c = (
+            st.active
+            & rc.ok_c
+            & ~rc.excl_c
+            & screen_fits
+            & screen_types
+            & (hb_c > 0)
+        )
+        nfeas = jnp.sum(feas_c.astype(jnp.int32))
+        viable_t = rc.ok_t & (rc.capt > 0)
+        t_first = jnp.argmin(
+            jnp.where(viable_t, jnp.arange(viable_t.shape[0]), INF_I)
+        )
+        anyt = jnp.any(viable_t)
+
+        any_e = jnp.any(feas_e) if E > 0 else jnp.zeros((), bool)
+        case = jnp.where(
+            any_e,
+            _CASE_EXISTING,
+            jnp.where(
+                nfeas > 1,
+                _CASE_LEVEL,
+                jnp.where(
+                    nfeas == 1, _CASE_SOLO, jnp.where(anyt, _CASE_NEW, _CASE_FAIL)
+                ),
+            ),
+        )
+
+        def commit_claims(rc, tgt, pred, kc, finals, fis, solo_units=None):
+            """tgt[j] gets pod ptr+j for j < kc; targets distinct unless
+            solo_units is set (then all window rows share tgt[0]). fis are
+            the surviving-type bits per window row, computed once by the
+            caller (they double as the exact-feasibility verify)."""
+            if solo_units is None:
+                added = jnp.zeros(N, jnp.int32).at[tgt].add(pred.astype(jnp.int32))
+                seq2 = seq.at[tgt].max(jnp.where(pred, nseq + jW, -1))
+                nseq2 = nseq + kc
+            else:
+                added = jnp.zeros(N, jnp.int32).at[tgt[0]].set(solo_units)
+                seq2 = seq.at[tgt[0]].set(nseq + solo_units - 1)
+                nseq2 = nseq + solo_units
+            crequests = st.crequests + added[:, None] * x.prequests[None, :]
+            count = st.count + added
+            creq = _set_rows(st.creq, tgt, finals, pred)
+            packs = jax.vmap(lambda fi: _pack(fi, IW))(fis)
+            cmaxs = jnp.max(
+                jnp.where(fis[..., None], tb.ialloc[None], -INF_I), axis=1
+            )
+            safe = jnp.where(pred, tgt, N)
+            alive = st.alive.at[safe].set(packs)
+            cmax_alloc = st.cmax_alloc.at[safe].set(cmaxs)
+            v_cnt, h_cnt = _record_window(
+                st, tb, finals, E + tgt, pred, selv, selh, ownh,
+                allow_wk=jnp.ones((), bool),
+            )
+            st2 = st._replace(
+                crequests=crequests, count=count, creq=creq, alive=alive,
+                cmax_alloc=cmax_alloc, v_cnt=v_cnt, h_cnt=h_cnt,
+            )
+            wk = jnp.where(pred, KIND_CLAIM, KIND_FAIL)
+            ws = jnp.where(pred, tgt, -1)
+            return st2, rc, seq2, nseq2, kc, wk, ws, jnp.zeros((), bool)
+
+        def case_existing(_):
+            caps = jnp.where(feas_e, jnp.minimum(rc.cape, h_budgets(0, E)), 0)
+            cum = jnp.cumsum(caps) - caps
+            total = jnp.sum(caps)
+            k = jnp.minimum(rem, jnp.minimum(total, W)).astype(jnp.int32)
+            tgt = jnp.argmax(
+                (jW[:, None] >= cum[None, :]) & (jW[:, None] < (cum + caps)[None, :]),
+                axis=1,
+            )
+            pred = jW < k
+            finals = _final_existing_rows(tb, st, x, tgt)
+            added = jnp.zeros(E, jnp.int32).at[tgt].add(pred.astype(jnp.int32))
+            eavail = st.eavail - added[:, None] * x.prequests[None, :]
+            ereq = _set_rows(st.ereq, tgt, finals, pred)
+            v_cnt, h_cnt = _record_window(
+                st, tb, finals, tgt, pred, selv, selh, ownh,
+                allow_wk=jnp.zeros((), bool),
+            )
+            st2 = st._replace(eavail=eavail, ereq=ereq, v_cnt=v_cnt, h_cnt=h_cnt)
+            rc2 = rc._replace(cape=rc.cape - added)
+            wk = jnp.where(pred, KIND_EXISTING, KIND_FAIL)
+            ws = jnp.where(pred, tgt, -1)
+            return st2, rc2, seq, nseq, k, wk, ws, jnp.zeros((), bool)
+
+        def case_level(_):
+            # one pod per feasible claim at the minimum count, in block
+            # order (creation order at count 1, promotion recency above)
+            cmin = jnp.min(jnp.where(feas_c, st.count, INF_I))
+            lvl = feas_c & (st.count == cmin)
+            ordkey = jnp.where(
+                lvl, jnp.where(cmin == 1, seq, _SEQ_LIM - 1 - seq), INF_I
+            )
+            order = jnp.argsort(ordkey)
+            nlvl = jnp.sum(lvl.astype(jnp.int32))
+            k = jnp.minimum(rem, jnp.minimum(nlvl, W)).astype(jnp.int32)
+            tgt = order[jnp.clip(jW, 0, N - 1)]
+            pred = jW < k
+            finals = _final_claim_rows(tb, st, x, tgt)
+            totals = st.crequests[tgt] + x.prequests[None, :]
+            # surviving-type bits for the grown request: both the exact
+            # feasibility verify (the _step while_loop equivalent) and the
+            # post-commit alive/cmax refresh
+            fis = jax.vmap(
+                lambda f, s, tot: _type_filter(f, _unpack(st.alive[s], I), tot, tb)
+            )(finals, tgt, totals)
+            okv = jnp.any(fis, axis=-1) | ~pred
+            newexcl = jnp.zeros(N + 1, bool).at[jnp.where(pred & ~okv, tgt, N)].set(
+                True
+            )[:N]
+            pred = pred & okv
+            kc = jnp.sum(pred.astype(jnp.int32))
+            # compact verified targets to the window front so pods
+            # ptr..ptr+kc-1 map onto them in block order
+            vorder = jnp.argsort(jnp.where(pred, jW, INF_I))
+            tgt = tgt[vorder]
+            finals = _rows_at(finals, vorder)
+            fis = fis[vorder]
+            pred = jW < kc
+            rc2 = rc._replace(excl_c=rc.excl_c | newexcl)
+            return commit_claims(rc2, tgt, pred, kc, finals, fis)
+
+        def case_solo(_):
+            s = jnp.argmax(feas_c)
+            finals = _final_claim_rows(tb, st, x, jnp.full((W,), s, jnp.int32))
+            final_n = _row(finals, 0)
+            alive_n = _unpack(st.alive[s], I)
+            per = jnp.where(
+                alive_n,
+                _pod_units(
+                    tb.ialloc - st.crequests[s][None, :], x.prequests[None, :]
+                ),
+                0,
+            )
+            tok = _type_filter(final_n, alive_n, st.crequests[s] + x.prequests, tb)
+            per = jnp.where(tok, per, 0)
+            cap = jnp.minimum(jnp.max(per, initial=0), hb_c[s])
+            k = jnp.minimum(rem, jnp.minimum(cap, W)).astype(jnp.int32)
+
+            def commit(_):
+                pred = jW < k
+                tgt = jnp.full((W,), s, jnp.int32)
+                # types surviving the k-pod load on this claim
+                fi_k = _type_filter(
+                    final_n, alive_n, st.crequests[s] + k * x.prequests, tb
+                )
+                fis = jnp.broadcast_to(fi_k, (W,) + fi_k.shape)
+                return commit_claims(rc, tgt, pred, k, finals, fis, solo_units=k)
+
+            def excl(_):
+                rc2 = rc._replace(excl_c=rc.excl_c.at[s].set(True))
+                return (
+                    st, rc2, seq, nseq, jnp.int32(0),
+                    jnp.full((W,), KIND_FAIL, jnp.int32),
+                    jnp.full((W,), -1, jnp.int32), jnp.zeros((), bool),
+                )
+
+            return jax.lax.cond(k > 0, commit, excl, None)
+
+        def case_new(_):
+            t = t_first
+            m = st.n_claims
+            oflow = m >= N
+
+            def create(_):
+                # per-claim fill: a fresh claim absorbs cstar pods (capacity
+                # and hostname-budget capped), then the next pod starts the
+                # next claim — so one step can create a whole batch of
+                # claims: pod j lands on claim m + j//cstar. The sequential
+                # order (create, fill, create, ...) is reproduced by the
+                # event seqs: later-created claims promoted later sit in
+                # front of their count block.
+                cstar = jnp.minimum(rc.capt[t], hb_fresh).astype(jnp.int32)
+                ncl = jnp.minimum(
+                    jnp.minimum((rem + cstar - 1) // cstar, N - m),
+                    jnp.maximum(W // cstar, 1),
+                ).astype(jnp.int32)
+                f = jnp.minimum(rem, jnp.minimum(ncl * cstar, W)).astype(jnp.int32)
+                ncl = (f + cstar - 1) // cstar  # claims actually touched
+                final_n = _row(rc.final_t, t)
+                pred = jW < f
+                cl_of = jnp.minimum(jW // cstar, N - 1 - 0)  # claim offset per pod
+                slot_of = jnp.where(pred, m + cl_of, N)  # OOB drops padding
+                # per-claim fill counts: full cstar except a partial last
+                fills = jnp.zeros(N + 1, jnp.int32).at[slot_of].add(1)[:N]
+                touched = fills > 0
+                crequests = jnp.where(
+                    touched[:, None],
+                    tb.tdaemon[t][None, :] + fills[:, None] * x.prequests[None, :],
+                    st.crequests,
+                )
+                alive_m = _unpack(rc.alive_t[t], I)
+                per = jnp.where(
+                    alive_m,
+                    _pod_units(
+                        tb.ialloc - tb.tdaemon[t][None, :], x.prequests[None, :]
+                    ),
+                    0,
+                )
+                # surviving types per touched claim depend on its fill
+                fi_full = alive_m & (per >= cstar)
+                packs_by_fill = jax.vmap(
+                    lambda k: _pack(alive_m & (per >= k), IW)
+                )(fills)  # [N, IW]
+                cmax_by_fill = jax.vmap(
+                    lambda k: jnp.max(
+                        jnp.where((alive_m & (per >= k))[:, None], tb.ialloc, -INF_I),
+                        axis=0,
+                    )
+                )(fills)
+                alive = jnp.where(touched[:, None], packs_by_fill, st.alive)
+                cmax_alloc = jnp.where(touched[:, None], cmax_by_fill, st.cmax_alloc)
+                finals_n = jax.tree.map(
+                    lambda a: jnp.broadcast_to(a, (N,) + a.shape), final_n
+                )
+                creq = K._reqs_where(touched, finals_n, st.creq)
+                count = jnp.where(touched, fills, st.count)
+                active = st.active | touched
+                tmpl = jnp.where(touched, t, st.tmpl)
+                # claim q's last fill event: cumulative pods through it
+                cumfills = jnp.cumsum(fills) - 1
+                seq2 = jnp.where(touched, nseq + cumfills, seq)
+                nseq2 = nseq + f
+                finals = jax.tree.map(
+                    lambda a: jnp.broadcast_to(a, (W,) + a.shape), final_n
+                )
+                v_cnt, h_cnt = _record_window(
+                    st, tb, finals, E + jnp.minimum(m + cl_of, N - 1), pred,
+                    selv, selh, ownh, allow_wk=jnp.ones((), bool),
+                )
+                st2 = st._replace(
+                    crequests=crequests, count=count, creq=creq, alive=alive,
+                    cmax_alloc=cmax_alloc, active=active, tmpl=tmpl,
+                    n_claims=m + ncl, v_cnt=v_cnt, h_cnt=h_cnt,
+                )
+                wk = jnp.where(pred, KIND_NEW, KIND_FAIL)
+                ws = jnp.where(pred, m + cl_of, -1)
+                return st2, rc, seq2, nseq2, f, wk, ws, jnp.zeros((), bool)
+
+            def overflow(_):
+                return (
+                    st, rc, seq, nseq, jnp.int32(0),
+                    jnp.full((W,), KIND_FAIL, jnp.int32),
+                    jnp.full((W,), -1, jnp.int32), jnp.ones((), bool),
+                )
+
+            return jax.lax.cond(oflow, overflow, create, None)
+
+        def case_fail(_):
+            k = jnp.minimum(rem, W).astype(jnp.int32)
+            return (
+                st, rc, seq, nseq, k,
+                jnp.full((W,), KIND_FAIL, jnp.int32),
+                jnp.full((W,), -1, jnp.int32), jnp.zeros((), bool),
+            )
+
+        st2, rc2, seq2, nseq2, k, wk, ws, oflow = jax.lax.switch(
+            case,
+            (
+                case_existing if E > 0 else case_fail,
+                case_level,
+                case_solo,
+                case_new,
+                case_fail,
+            ),
+            None,
+        )
+        kinds = write_window(kinds, ptr, wk)
+        slots = write_window(slots, ptr, ws)
+        return st2, rc2, seq2, nseq2, ptr + k, kinds, slots, over | oflow
+
+    def cond(carry):
+        (_, _, _, _, ptr, _, _, over), _ = carry
+        return (ptr < n_valid) & ~over
+
+    def body(carry):
+        inner, iters = carry
+        st, rc, seq, nseq, ptr, kinds, slots, over = inner
+        # non-affinity bulk heads build the cache up front and commit their
+        # own pod through the bulk machinery — one heavy evaluation per run
+        # instead of two (the exact step would redo it)
+        head_build = (
+            rx.is_head[ptr] & rx.bulk[ptr] & ~rx.aff[ptr] & rx.x.valid[ptr]
+        )
+        rc = jax.lax.cond(
+            head_build,
+            lambda: _build_cache(tb, st, xrow(ptr)),
+            lambda: rc,
+        )
+        inner = (st, rc, seq, nseq, ptr, kinds, slots, over)
+        use_bulk = rc.active & rx.bulk[ptr] & (head_build | ~rx.is_head[ptr])
+        out = jax.lax.cond(use_bulk, bulk_step, single_step, inner)
+        return out, (iters[0] + 1, iters[1] + use_bulk.astype(jnp.int32))
+
+    rc0 = _empty_cache(tb, st)
+    (st, rc, seq, next_seq, ptr, kinds, slots, over), iters = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            (st, rc0, seq, next_seq, jnp.int32(0), kinds0, slots0, jnp.zeros((), bool)),
+            (jnp.int32(0), jnp.int32(0)),
+        ),
+    )
+    return st, seq, next_seq, kinds[:P], slots[:P], over, iters

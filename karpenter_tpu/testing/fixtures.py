@@ -270,3 +270,45 @@ def make_preference_pods(count: int) -> list[Pod]:
             )
         )
     return out
+
+
+def make_underutilized_fleet(op, n_nodes: int, rider_requests=None, max_ticks=200):
+    """Provision `n_nodes` one-pod nodes through the real control plane
+    (hostname anti-affinity forces one node per seed pod), then swap each
+    seed for a small bound RUNNING rider — the classic multi-node
+    consolidation setup (an under-utilized fleet a fraction of one big node
+    could absorb)."""
+    from karpenter_tpu.api import labels as well_known
+    from karpenter_tpu.api.objects import PodPhase
+
+    seeds = []
+    for i in range(n_nodes):
+        p = pod(
+            name=f"seed-{i}",
+            labels={"fleet": "seed"},
+            requests={"cpu": "700m", "memory": "512Mi"},
+            pod_anti_requirements=[
+                PodAffinityTerm(
+                    topology_key=well_known.HOSTNAME_LABEL_KEY,
+                    label_selector=LabelSelector(match_labels={"fleet": "seed"}),
+                )
+            ],
+        )
+        seeds.append(p)
+        op.kube.create("Pod", p)
+    op.run_until_settled(max_ticks=max_ticks, advance_seconds=2.0)
+    nodes = op.kube.list("Node")
+    assert len(nodes) >= n_nodes, f"fleet setup made {len(nodes)} nodes"
+    # swap seeds for small bound riders (no anti-affinity -> consolidatable)
+    for i, p in enumerate(seeds):
+        node_name = op.kube.get("Pod", p.name).node_name
+        op.kube.delete("Pod", p.name)
+        rider = pod(
+            name=f"rider-{i}",
+            labels={"fleet": "rider"},
+            requests=dict(rider_requests or {"cpu": "100m", "memory": "128Mi"}),
+        )
+        rider.node_name = node_name
+        rider.phase = PodPhase.RUNNING
+        op.kube.create("Pod", rider)
+    return op

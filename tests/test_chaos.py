@@ -1,0 +1,104 @@
+"""Chaos guard: under a steady workload, the provision/consolidate loop
+must converge and STAY converged — no runaway scale-up or node-count
+oscillation (reference test/suites/regression/chaos_test.go:48-90, which
+watches for consolidation and emptiness fighting the provisioner).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api.objects import Budget, PodPhase
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.controllers.kube import FakeClock
+from karpenter_tpu.controllers.operator import Operator
+from karpenter_tpu.testing import fixtures
+
+
+def steady_operator(n_pods: int = 10) -> Operator:
+    op = Operator(clock=FakeClock(), force_oracle=True)
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 8, 32])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    fixtures.reset_rng(5)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(
+            name="default",
+            budgets=[Budget(nodes="100%")],
+            consolidate_after_seconds=0.0,
+        ),
+    )
+    for i in range(n_pods):
+        op.kube.create(
+            "Pod",
+            fixtures.pod(name=f"w-{i}", requests={"cpu": "400m", "memory": "256Mi"}),
+        )
+    op.run_until_settled(max_ticks=60)
+    for p in op.kube.list("Pod"):
+        p.phase = PodPhase.RUNNING
+        op.kube.update("Pod", p)
+    return op
+
+
+def test_no_runaway_scaleup_under_steady_workload():
+    """chaos_test.go:48 ScaleUp guard: with nothing changing, node count
+    must converge within a bounded number of loop iterations and then hold
+    perfectly still — every later tick sees the same node set."""
+    op = steady_operator()
+    history = []
+    for _ in range(120):  # 4 simulated minutes of control loops
+        op.step(2.0)
+        history.append(len(op.kube.list("Node")))
+
+    # convergence: the last 40 ticks (80s — several disruption TTL windows)
+    # hold one value
+    tail = history[-40:]
+    assert len(set(tail)) == 1, f"node count oscillates: {history[-60:]}"
+    # no runaway: the fleet never exceeds a sane multiple of its converged
+    # size (the chaos test's scale-up guard)
+    assert max(history) <= max(2 * tail[0], tail[0] + 2), history
+    # the workload survived every disruption decision
+    pods = op.kube.list("Pod")
+    assert pods and all(p.node_name for p in pods)
+    # and the exact node SET is stable, not just the count
+    names_a = {n.name for n in op.kube.list("Node")}
+    for _ in range(10):
+        op.step(2.0)
+    names_b = {n.name for n in op.kube.list("Node")}
+    assert names_a == names_b, "steady state must not churn nodes"
+
+
+def test_no_oscillation_after_consolidation():
+    """After a consolidation shrinks the fleet, the provisioner must not
+    re-expand it (the classic runaway loop: delete -> reprovision ->
+    delete...). Converge, then hold."""
+    op = steady_operator(n_pods=6)
+    # over-provision by hand: add then remove load so nodes turn empty
+    for i in range(6):
+        op.kube.create(
+            "Pod",
+            fixtures.pod(name=f"burst-{i}", requests={"cpu": "1500m"}),
+        )
+    op.run_until_settled(max_ticks=60)
+    for i in range(6):
+        op.kube.delete("Pod", f"burst-{i}")
+    # let consolidation clean up, then require stability. A replica
+    # controller keeps the steady workload alive (the reference chaos test
+    # runs a Deployment): evicted pods are recreated pending
+    history = []
+    for _ in range(150):
+        op.step(2.0)
+        live = {p.name for p in op.kube.list("Pod")}
+        for i in range(6):
+            if f"w-{i}" not in live:
+                op.kube.create(
+                    "Pod",
+                    fixtures.pod(
+                        name=f"w-{i}",
+                        requests={"cpu": "400m", "memory": "256Mi"},
+                    ),
+                )
+        history.append(len(op.kube.list("Node")))
+    tail = history[-40:]
+    assert len(set(tail)) == 1, f"post-consolidation oscillation: {history[-60:]}"
+    assert tail[0] <= history[0], "fleet must not grow after load drops"
+    pods = op.kube.list("Pod")
+    assert pods and all(p.node_name for p in pods)

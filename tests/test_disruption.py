@@ -460,3 +460,145 @@ def test_static_drift_replaces_node_with_pods():
         "no simulation veto)"
     )
     assert op.cluster.nodepool_state._reserved.get("warm", 0) == 0
+
+
+def test_batched_sweep_equals_binary_on_fleet():
+    """The one-invocation prefix sweep (disruption/sweep.py) must choose the
+    same command as the reference-shaped sequential binary search on a real
+    under-utilized fleet."""
+    from karpenter_tpu.api.objects import Budget
+
+    op = Operator(clock=FakeClock(), force_oracle=True)
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 32])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    fixtures.reset_rng(21)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="default", budgets=[Budget(nodes="100%")]),
+    )
+    fixtures.make_underutilized_fleet(op, 8)
+    op.clock.advance(26.0)
+    op.pod_events.reconcile_all()
+    op.claim_conditions.reconcile_all()
+
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    sweep = MultiNodeConsolidation(*args, sweep="batched", options=op.opts,
+                                   force_oracle=False)
+    binary = MultiNodeConsolidation(*args, sweep="binary", options=op.opts,
+                                    force_oracle=True)
+    ca = sweep.compute_commands()
+    cb = binary.compute_commands()
+    na = sorted(c.name for cmd in ca for c in cmd.candidates)
+    nb = sorted(c.name for cmd in cb for c in cmd.candidates)
+    assert na == nb and len(na) >= 5, (na, nb)
+    assert ca[0].decision == cb[0].decision
+
+
+def test_prefix_feasibility_one_invocation():
+    """prefix_feasibility evaluates every removal prefix in one vmapped
+    device call and its verdicts match per-prefix sequential simulation."""
+    from karpenter_tpu.api.objects import Budget
+    from karpenter_tpu.controllers.disruption.sweep import prefix_feasibility
+
+    op = Operator(clock=FakeClock(), force_oracle=True)
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 32])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    fixtures.reset_rng(21)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="default", budgets=[Budget(nodes="100%")]),
+    )
+    fixtures.make_underutilized_fleet(op, 6)
+    op.clock.advance(26.0)
+    op.pod_events.reconcile_all()
+    op.claim_conditions.reconcile_all()
+
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    mnc = MultiNodeConsolidation(*args, options=op.opts, force_oracle=True)
+    cands = mnc.candidates()
+    assert len(cands) >= 4
+    feas = prefix_feasibility(op.kube, op.cluster, op.cloud, cands, op.opts)
+    assert len(feas) == len(cands)
+    # sequential referee: full simulation per prefix
+    for k in range(1, len(cands) + 1):
+        sim = simulate_scheduling(
+            op.kube, op.cluster, op.cloud, cands[:k], op.opts, force_oracle=True
+        )
+        seq_ok = sim.all_pods_scheduled() and len(sim.non_empty_new_claims()) <= 1
+        assert feas[k - 1] == seq_ok, f"prefix {k}: sweep={feas[k-1]} seq={seq_ok}"
+
+
+def test_spot_to_spot_consolidation_floor():
+    """consolidation.go:237: replacing a single spot node with spot requires
+    >= 15 cheaper instance types; below the floor the command is a no-op,
+    and the gate being off blocks spot-to-spot entirely."""
+    from karpenter_tpu.options import FeatureGates, Options
+
+    def build(gate_on, sizes):
+        op = Operator(
+            clock=FakeClock(),
+            force_oracle=True,
+            options=Options(
+                feature_gates=FeatureGates(spot_to_spot_consolidation=gate_on)
+            ),
+        )
+        op.raw_cloud.types = construct_instance_types(sizes=sizes)
+        op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+        fixtures.reset_rng(21)
+        from karpenter_tpu.api.objects import Budget, NodeSelectorRequirement, Operator as OpEnum
+
+        op.kube.create(
+            "NodePool",
+            fixtures.node_pool(
+                name="default",
+                budgets=[Budget(nodes="100%")],
+                requirements=[
+                    NodeSelectorRequirement(
+                        well_known.CAPACITY_TYPE_LABEL_KEY,
+                        OpEnum.IN,
+                        ["spot"],
+                    )
+                ],
+            ),
+        )
+        # provision a BIG spot node with a big seed pod, then swap the seed
+        # for a tiny bound rider -> over-sized node, cheaper spot types exist
+        p = fixtures.pod(name="seed", requests={"cpu": "7", "memory": "6Gi"})
+        op.kube.create("Pod", p)
+        op.run_until_settled(max_ticks=40)
+        node_name = op.kube.get("Pod", "seed").node_name
+        op.kube.delete("Pod", "seed")
+        rider = fixtures.pod(name="rider", requests={"cpu": "100m"})
+        rider.node_name = node_name
+        rider.phase = PodPhase.RUNNING
+        op.kube.create("Pod", rider)
+        mark_consolidatable(op)
+        from karpenter_tpu.controllers.disruption.consolidation import (
+            SingleNodeConsolidation,
+        )
+
+        return op, SingleNodeConsolidation(
+            op.kube, op.cluster, op.cloud, op.clock,
+            options=op.opts, force_oracle=True,
+        )
+
+    # gate off: spot->spot never happens
+    many_sizes = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 14, 16, 24, 32, 48]
+    op, snc = build(False, many_sizes)
+    cmds = snc.compute_commands()
+    assert not any(c.replacements for c in cmds), "gate off must block spot->spot"
+
+    # gate on with a rich universe (>= 15 cheaper types): replacement allowed
+    op, snc = build(True, many_sizes)
+    cmds = snc.compute_commands()
+    assert any(
+        cmd.replacements for cmd in cmds
+    ), "gate on with >=15 cheaper types must replace"
+    # the replacement's options are capped at the 15 cheapest types
+    repl = next(cmd for cmd in cmds if cmd.replacements).replacements[0]
+    assert len(repl.instance_type_options) <= 15
+
+    # gate on but a poor universe (< 15 cheaper types): no-op
+    op, snc = build(True, [8, 16])
+    cmds = snc.compute_commands()
+    assert not any(c.replacements for c in cmds), "below the 15-type floor"

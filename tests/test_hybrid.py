@@ -84,12 +84,61 @@ def test_force_oracle():
     assert sum(results.node_pod_counts()) + len(results.pod_errors) == len(pods)
 
 
-def test_host_ports_fall_back():
+def test_host_ports_partition_to_oracle():
+    """A host-ports pod rides the oracle continuation while the rest of the
+    batch stays on the kernel (per-pod partitioning; whole-batch fallback
+    was the round-2 cliff)."""
     fixtures.reset_rng(7)
     pods = fixtures.make_generic_pods(4)
     pods[2].host_ports = [("", "TCP", 8080)]
     h = HybridScheduler(*_problem(pods))
     results = h.solve(pods)
-    assert h.used_tpu is False
+    assert h.used_tpu is True
     assert "host ports" in h.fallback_reason
+    assert "continued on the oracle" in h.fallback_reason
     assert not results.pod_errors
+    placed = {p.name for c in results.new_node_claims for p in c.pods}
+    assert len(placed) == len(pods)
+
+
+def test_mixed_batch_partitions_per_pod():
+    """The round-2 fallback cliff: ONE relaxable pod in a supported batch
+    must not drag everything to the oracle. The kernel packs the bulk; the
+    oracle continues on the decoded state for the leftovers."""
+    from karpenter_tpu.api.objects import (
+        LabelSelector,
+        TopologySpreadConstraint,
+        WhenUnsatisfiable,
+    )
+    from karpenter_tpu.api import labels as well_known
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver import HybridScheduler, Topology
+    from karpenter_tpu.testing import fixtures
+
+    fixtures.reset_rng(3)
+    its = construct_instance_types(sizes=[2, 8])
+    pool = fixtures.node_pool(name="default")
+    pods = fixtures.make_diverse_pods(40)
+    relaxable = fixtures.pod(
+        name="anyway",
+        labels={"app": "web"},
+        requests={"cpu": "100m"},
+        topology_spread_constraints=[
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=well_known.TOPOLOGY_ZONE_LABEL_KEY,
+                when_unsatisfiable=WhenUnsatisfiable.SCHEDULE_ANYWAY,
+                label_selector=LabelSelector(match_labels={"app": "web"}),
+            )
+        ],
+    )
+    pods.append(relaxable)
+    topo = Topology([pool], {"default": its}, pods)
+    s = HybridScheduler([pool], {"default": its}, topo)
+    r = s.solve(pods)
+    assert s.used_tpu is True, s.fallback_reason
+    assert s.fallback_reason and "continued on the oracle" in s.fallback_reason
+    assert not r.pod_errors, r.pod_errors
+    placed = {p.name for c in r.new_node_claims for p in c.pods}
+    assert "anyway" in placed
+    assert len(placed) == len(pods)

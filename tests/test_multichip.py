@@ -31,7 +31,7 @@ def test_sharded_solve_scan_matches_unsharded(jax_mesh):
 
     from karpenter_tpu.solver import tpu_kernel as K
 
-    tb, st, xs = ge._small_problem(n_pods=16)
+    tb, st, xs, _, _ = ge._small_problem(n_pods=16)
     assert st.active.shape[0] % 8 == 0
 
     st_ref, kinds_ref, slots_ref, _ = jax.jit(K.solve_scan)(tb, st, xs)

@@ -1,0 +1,215 @@
+"""Solver service conformance: the UDS frame protocol, flat-array pod
+payloads, live cluster state over the wire, error frames, and the compiled
+C++ client (native/solver_client.cc) against a live SolverServer.
+
+This is the Solver boundary of the north star (control plane -> sidecar,
+SURVEY.md §7 M5); the result of a remote solve with existing nodes must
+match the in-process solve byte-for-byte in its assignments.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import socket
+import struct
+import subprocess
+import tempfile
+import os
+
+import pytest
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.solver import HybridScheduler, Topology
+from karpenter_tpu.solver.nodes import StateNodeView
+from karpenter_tpu.solver.oracle import SchedulerOptions
+from karpenter_tpu.solver.service import (
+    KIND_ERROR,
+    KIND_SOLVE,
+    MAGIC,
+    SolverClient,
+    SolverServer,
+    encode_problem_request,
+)
+from karpenter_tpu.testing import fixtures
+
+
+@pytest.fixture()
+def server():
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SolverServer(path)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _views():
+    return [
+        StateNodeView(
+            name=f"existing-{z}",
+            node_labels={
+                well_known.TOPOLOGY_ZONE_LABEL_KEY: z,
+                well_known.HOSTNAME_LABEL_KEY: f"existing-{z}",
+            },
+            labels={
+                well_known.TOPOLOGY_ZONE_LABEL_KEY: z,
+                well_known.HOSTNAME_LABEL_KEY: f"existing-{z}",
+                well_known.INSTANCE_TYPE_LABEL_KEY: "c-2x-amd64-linux",
+                well_known.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+                well_known.OS_LABEL_KEY: "linux",
+                well_known.ARCH_LABEL_KEY: "amd64",
+                well_known.NODEPOOL_LABEL_KEY: "default",
+            },
+            available={"cpu": 1500, "memory": 3 * 1024**3 * 1000, "pods": 20_000},
+            capacity={"cpu": 2000, "memory": 4 * 1024**3 * 1000},
+            initialized=True,
+        )
+        for z in ("test-zone-a", "test-zone-b")
+    ]
+
+
+def _problem(n=12, with_views=True):
+    fixtures.reset_rng(11)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    pods = fixtures.make_diverse_pods(n)
+    views = _views() if with_views else None
+    return pools, {"default": its}, pods, views
+
+
+def _inprocess(pools, its_by_pool, pods, views):
+    topo = Topology(pools, its_by_pool, pods, state_node_views=views)
+    # force_oracle on both sides: these tests verify the WIRE, not the
+    # kernel (the oracle avoids a per-test jit compile on the CPU backend)
+    s = HybridScheduler(
+        pools, its_by_pool, topo, views, None, SchedulerOptions(),
+        force_oracle=True,
+    )
+    return s.solve(pods), s
+
+
+def test_ping_and_solve_roundtrip(server):
+    c = SolverClient(server.socket_path)
+    c.connect(timeout=120.0)
+    assert c.ping()
+    pools, ibp, pods, views = _problem(with_views=False)
+    got = c.solve(pools, ibp, pods, force_oracle=True)
+    name_of = {p.uid: p.name for p in pods}
+    r, _ = _inprocess(*_problem(with_views=False))
+    remote_parts = sorted(
+        tuple(sorted(name_of[u] for u in cl["pod_uids"]))
+        for cl in got["new_node_claims"]
+    )
+    local_parts = sorted(
+        tuple(sorted(p.name for p in cl.pods))
+        for cl in r.new_node_claims
+        if cl.pods
+    )
+    assert remote_parts == local_parts
+    assert {name_of[u] for u in got["pod_errors"]} == {
+        name_of2[u] for name_of2 in [{p.uid: p.name for p in _problem(with_views=False)[2]}] for u in r.pod_errors
+    }
+    c.close()
+
+
+def test_solve_with_existing_nodes_matches_inprocess(server):
+    """The round-2 gap: a sidecar solve of a NON-empty cluster must see the
+    existing capacity (helpers.go:52-143 — the simulator always does)."""
+    c = SolverClient(server.socket_path)
+    c.connect(timeout=120.0)
+    pools, ibp, pods, views = _problem(with_views=True)
+    got = c.solve(pools, ibp, pods, state_node_views=views, force_oracle=True)
+    name_of = {p.uid: p.name for p in pods}
+    r, _ = _inprocess(*_problem(with_views=True))
+    local_existing = {
+        p.name: n.name for n in r.existing_nodes for p in n.pods
+    }
+    remote_existing = {
+        name_of[u]: n for u, n in got["existing_assignments"].items()
+    }
+    assert remote_existing == local_existing
+    assert local_existing, "scenario must actually use existing capacity"
+    remote_parts = sorted(
+        tuple(sorted(name_of[u] for u in cl["pod_uids"]))
+        for cl in got["new_node_claims"]
+        if cl["pod_uids"]
+    )
+    local_parts = sorted(
+        tuple(sorted(p.name for p in cl.pods))
+        for cl in r.new_node_claims
+        if cl.pods
+    )
+    assert remote_parts == local_parts
+    c.close()
+
+
+def test_error_frame_on_garbage(server):
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(5)
+    sock.connect(server.socket_path)
+    payload = b"this is not json"
+    sock.sendall(MAGIC + struct.pack("<II", KIND_SOLVE, len(payload)) + payload)
+    head = b""
+    while len(head) < 12:
+        head += sock.recv(12 - len(head))
+    kind, length = struct.unpack("<II", head[4:])
+    assert kind == KIND_ERROR
+    sock.close()
+
+
+def test_timeout_frame(server):
+    """A ~zero budget must come back timed_out, not hang."""
+    c = SolverClient(server.socket_path)
+    c.connect(timeout=120.0)
+    pools, ibp, pods, _ = _problem(n=40, with_views=False)
+    got = c.solve(
+        pools, ibp, pods, options=SchedulerOptions(timeout_seconds=1e-9),
+        force_oracle=True,
+    )
+    assert got["timed_out"] is True
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# the native client
+
+
+def _build_native(tmpdir: str) -> str:
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ in environment")
+    out = os.path.join(tmpdir, "solver_client")
+    src = os.path.join(os.path.dirname(__file__), "..", "native", "solver_client.cc")
+    subprocess.run([gxx, "-O2", "-std=c++17", "-o", out, src], check=True)
+    return out
+
+
+def test_native_client_ping_and_solve(server, tmp_path):
+    exe = _build_native(str(tmp_path))
+    got = subprocess.run(
+        [exe, server.socket_path, "ping"], capture_output=True, timeout=30
+    )
+    assert got.returncode == 0, got.stderr
+
+    pools, ibp, pods, views = _problem(with_views=True)
+    req = encode_problem_request(
+        pools, ibp, pods, views, None, SchedulerOptions(), force_oracle=True
+    )
+    got = subprocess.run(
+        [exe, server.socket_path, "solve"],
+        input=req,
+        capture_output=True,
+        timeout=120,
+    )
+    assert got.returncode == 0, got.stderr
+    resp = json.loads(got.stdout)
+    r, _ = _inprocess(*_problem(with_views=True))
+    local_existing = {p.uid for n in r.existing_nodes for p in n.pods}
+    # decode the flat assignment array the C++ client passed through
+    from karpenter_tpu.solver.service import decode_result
+
+    decoded = decode_result(resp, pods)
+    name_of = {p.uid: p.name for p in pods}
+    local_names = {p.name for n in r.existing_nodes for p in n.pods}
+    assert {name_of[u] for u in decoded["existing_assignments"]} == local_names

@@ -1,0 +1,100 @@
+"""The control plane ON the TPU path: end-to-end flows that assert the
+batched kernel (not the oracle fallback) produced the decisions — through a
+provisioner tick with daemonsets + existing nodes, and through a
+consolidation simulation (the round-2 gap: every control-plane test forced
+the oracle; here `used_tpu` is the assertion).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import Budget, PodPhase
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.controllers.disruption.helpers import (
+    build_candidates,
+    simulate_scheduling,
+)
+from karpenter_tpu.controllers.kube import DaemonSet, FakeClock
+from karpenter_tpu.controllers.operator import Operator
+from karpenter_tpu.testing import fixtures
+
+
+def tpu_operator():
+    op = Operator(clock=FakeClock(), force_oracle=False)
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 8])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    fixtures.reset_rng(33)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="default", budgets=[Budget(nodes="100%")]),
+    )
+    return op
+
+
+def test_provision_e2e_rides_tpu():
+    """pending -> bound entirely through the TPU kernel, with a daemonset
+    shaping claim overhead and a second wave landing on EXISTING nodes."""
+    op = tpu_operator()
+    op.kube.create(
+        "DaemonSet",
+        DaemonSet(
+            name="logging",
+            pod_template=fixtures.pod(name="ds-template", requests={"cpu": "50m"}),
+        ),
+    )
+    for i in range(4):
+        op.kube.create(
+            "Pod", fixtures.pod(name=f"w-{i}", requests={"cpu": "300m", "memory": "256Mi"})
+        )
+    op.run_until_settled(max_ticks=60)
+    assert op.provisioner.last_solver_used == "tpu"
+    assert all(p.node_name for p in op.kube.list("Pod"))
+    nodes = op.kube.list("Node")
+    assert nodes
+
+    # second wave: pods must pack onto the EXISTING nodes via the kernel
+    n_nodes = len(nodes)
+    for i in range(3):
+        op.kube.create(
+            "Pod", fixtures.pod(name=f"w2-{i}", requests={"cpu": "100m"})
+        )
+    op.run_until_settled(max_ticks=60)
+    assert op.provisioner.last_solver_used == "tpu"
+    assert all(p.node_name for p in op.kube.list("Pod"))
+    assert len(op.kube.list("Node")) == n_nodes, (
+        "small second-wave pods must land on existing capacity"
+    )
+
+
+def test_consolidation_simulation_rides_tpu():
+    """SimulateScheduling through the kernel with existing nodes and bound
+    pods: the disruption decision is TPU-produced (helpers.go:52-143)."""
+    op = tpu_operator()
+    fixtures.make_underutilized_fleet(op, 5)
+    op.clock.advance(26.0)
+    op.pod_events.reconcile_all()
+    op.claim_conditions.reconcile_all()
+
+    cands = build_candidates(op.kube, op.cluster, op.cloud, op.clock, lambda c: True)
+    assert len(cands) >= 3
+    sim = simulate_scheduling(
+        op.kube, op.cluster, op.cloud, cands[:3], op.opts, force_oracle=False
+    )
+    assert sim.used_tpu is True
+    assert sim.all_pods_scheduled()
+
+
+def test_consolidation_e2e_rides_tpu():
+    """The full disruption loop (candidates -> simulate -> validate ->
+    execute) with the kernel doing every simulation: the fleet shrinks and
+    the workload survives."""
+    op = tpu_operator()
+    fixtures.make_underutilized_fleet(op, 4)
+    before = len(op.kube.list("Node"))
+    for _ in range(60):
+        op.step(2.0)
+        if len(op.kube.list("Node")) < before and not op.disruption.queue.busy:
+            break
+    assert len(op.kube.list("Node")) < before, "fleet must consolidate"
+    pods = [p for p in op.kube.list("Pod")]
+    assert pods and all(p.node_name for p in pods)
