@@ -191,12 +191,11 @@ def prefix_feasibility(
             if pod.phase in TERMINAL_PHASES or pod.terminating:
                 continue
             resched = pod.uid in union_uids
-            if pod.pod_anti_affinity and not resched:
-                # a bound, non-reschedulable anti-affinity pod creates an
-                # inverse group whose existence differs per prefix
-                raise SweepUnsupported(
-                    "non-reschedulable anti-affinity pod on candidate"
-                )
+            if pod.pod_anti_affinity:
+                # anti-affinity pods on candidates create inverse hostname
+                # groups whose per-prefix counts this construction doesn't
+                # restore — bail to the sequential scan
+                raise SweepUnsupported("anti-affinity pod on candidate")
             for g, vg in enumerate(problem.vgroups):
                 tg = vg.group
                 if pod.namespace not in tg.namespaces:

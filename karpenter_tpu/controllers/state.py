@@ -274,7 +274,11 @@ class NodePoolState:
             s.discard(claim)
         if not e["active"] and not e["deleting"]:
             self._pools.pop(pool, None)
-            self._reserved.pop(pool, None)
+            # reservations held by in-flight commands must survive the pool
+            # entry going empty, or a concurrent scale-up could burst the
+            # node limit while the command's launch is still pending
+            if self._reserved.get(pool, 0) == 0:
+                self._reserved.pop(pool, None)
 
     def node_counts(self, pool: str) -> tuple[int, int, int]:
         """(active, deleting, pending_disruption)"""

@@ -64,8 +64,10 @@ class StaticProvisioning:
                 continue
             # provisioning/controller.go:93: reserve against the node limit
             # so concurrent scale decisions can't burst over it
+            # pending-disruption claims count as active (their replacements
+            # are already being created), so the deficit subtracts both
             grant = self.cluster.nodepool_state.reserve_node_count(
-                np.name, node_limit(np), np.replicas - active
+                np.name, node_limit(np), np.replicas - active - pending
             )
             for _ in range(grant):
                 self._create_claim(np)

@@ -49,14 +49,16 @@ def _term_key(t: PodAffinityTerm, pod: Pod) -> tuple:
     )
 
 
-def pod_class_signature(pod: Pod) -> int:
-    """A hash over every decision-relevant pod field. Two pods with equal
-    signatures and equal requests make identical scheduling decisions
+def pod_class_key(pod: Pod) -> tuple:
+    """The canonical tuple of every decision-relevant pod field. Two pods
+    with equal keys and equal requests make identical scheduling decisions
     against any solver state (their labels may still differ — labels only
     drive topology-count records, which the kernel applies per pod).
     Memoized on the pod object: the sort and the encoder both consult it
-    for every pod of every solve."""
-    cached = getattr(pod, "_ktpu_class_sig", None)
+    for every pod of every solve. Dedup uses THIS tuple (exact equality);
+    the crc in pod_class_signature is only a sort tie-break, where a
+    collision merely reorders ties."""
+    cached = getattr(pod, "_ktpu_class_key", None)
     if cached is not None:
         return cached
     na = pod.node_affinity
@@ -113,19 +115,33 @@ def pod_class_signature(pod: Pod) -> int:
         tuple(sorted(pod.host_ports)),
         tuple(sorted(pod.volume_claims)),
     )
-    # crc over the canonical repr: stable across processes (unlike hash())
-    sig = zlib.crc32(repr(key).encode())
+    try:
+        pod._ktpu_class_key = key
+    except AttributeError:
+        pass  # frozen/slotted pods just recompute
+    return key
+
+
+def pod_class_signature(pod: Pod) -> int:
+    """A 32-bit digest of pod_class_key for the FFD sort tie-break only —
+    stable across processes (unlike hash()); collisions just group ties
+    differently, never merge distinct classes."""
+    cached = getattr(pod, "_ktpu_class_sig", None)
+    if cached is not None:
+        return cached
+    sig = zlib.crc32(repr(pod_class_key(pod)).encode())
     try:
         pod._ktpu_class_sig = sig
     except AttributeError:
-        pass  # frozen/slotted pods just recompute
+        pass
     return sig
 
 
 def pod_encode_class(pod: Pod, requests) -> tuple:
-    """Key under which pods share identical solver encodings: the class
-    signature plus the exact request vector."""
-    return (pod_class_signature(pod), tuple(sorted(requests.items())))
+    """Key under which pods share identical solver encodings: the full
+    canonical class tuple plus the exact request vector (exact equality —
+    no hashing on the dedup path)."""
+    return (pod_class_key(pod), tuple(sorted(requests.items())))
 
 
 def ffd_sort_key(pod: Pod, requests: res.ResourceList):

@@ -27,3 +27,19 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# The suite compiles hundreds of distinct kernel shapes in one process; the
+# accumulated executable cache has segfaulted XLA's CPU compiler late in
+# long runs. Dropping caches between test MODULES bounds memory at the cost
+# of a few re-compiles.
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    yield
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass

@@ -136,7 +136,7 @@ def spread_pods(
 
 
 @pytest.mark.parametrize("key", [ZONE, HOSTNAME, CAPACITY])
-@pytest.mark.parametrize("max_skew", [1, 2, 4])
+@pytest.mark.parametrize("max_skew", [1, 2, 3, 4])
 @pytest.mark.parametrize("n", [7, 18])
 def test_spread_matrix(key, max_skew, n):
     run_parity(problem(lambda: spread_pods(n, key=key, max_skew=max_skew)))
@@ -146,7 +146,7 @@ def test_spread_matrix(key, max_skew, n):
 # 2. minDomains
 
 
-@pytest.mark.parametrize("min_domains", [1, 2, 3, 4, 6])
+@pytest.mark.parametrize("min_domains", [1, 2, 3, 4, 5, 6])
 @pytest.mark.parametrize("max_skew", [1, 3])
 def test_min_domains(min_domains, max_skew):
     # The KWOK universe spans 4 zones (cloudprovider/kwok.py). minDomains
@@ -250,7 +250,7 @@ def test_affinity_policy_with_zonal_affinity(affinity_policy):
 
 
 @pytest.mark.parametrize("second_key", [HOSTNAME, CAPACITY])
-@pytest.mark.parametrize("n", [6, 14])
+@pytest.mark.parametrize("n", [6, 10, 14])
 def test_multi_tsc_pod(second_key, n):
     def pods():
         extra = [
@@ -638,9 +638,31 @@ def test_schedule_anyway_relaxes(n):
     )
 
 
-@pytest.mark.parametrize("seed", [1, 7, 13, 29, 71, 97, 113, 131, 151, 173])
+@pytest.mark.parametrize("seed", [1, 7, 13, 29, 71, 97, 113, 131, 151, 173, 191, 211, 229, 251, 271, 283])
 def test_randomized_diverse_mix(seed):
     def pods():
         return fixtures.make_diverse_pods(40)
 
     run_parity(problem(pods, seed=seed))
+
+
+# ---------------------------------------------------------------------------
+# 9. capacity-type spread with a capacity-type selector
+
+
+@pytest.mark.parametrize("ct", [["spot"], ["on-demand"], ["spot", "on-demand"]])
+def test_capacity_type_spread_with_ct_requirement(ct):
+    """Spread over capacity-type while the pod itself constrains the same
+    key — the tighten and the constraint share a vocab segment."""
+
+    def pods():
+        return spread_pods(
+            6,
+            key=CAPACITY,
+            node_requirements=[NodeSelectorRequirement(CAPACITY, Operator.IN, ct)],
+        )
+
+    expect_errors = len(ct) == 1  # a 1-value universe strands pods at skew 1
+    r = run_parity(problem(pods), expect_errors=expect_errors)
+    if not expect_errors:
+        assert not r.pod_errors
