@@ -25,7 +25,7 @@ from karpenter_tpu.controllers.disruption.types import Command
 from karpenter_tpu.controllers.state import DISRUPTED_TAINT
 from karpenter_tpu.events import Recorder
 from karpenter_tpu.options import Options
-from karpenter_tpu import metrics
+from karpenter_tpu import logging, metrics
 
 EVAL_DURATION = metrics.REGISTRY.histogram(
     "karpenter_disruption_evaluation_duration_seconds",
@@ -74,6 +74,7 @@ class DisruptionController:
         self.validation_ttl = validation_ttl_seconds
         self._pending_validation: Optional[tuple[float, Command]] = None
         self._last_run = -1e18
+        self.log = logging.root.named("disruption")
 
     def reconcile(self) -> Optional[Command]:
         """One loop iteration (controller.go:121). Returns the command that
@@ -87,8 +88,20 @@ class DisruptionController:
                 return None
             self._pending_validation = None
             if self.validator.validate(cmd):
+                self.log.info(
+                    "executing disruption command",
+                    reason=cmd.reason,
+                    decision=cmd.decision,
+                    candidates=len(cmd.candidates),
+                    replacements=len(cmd.replacements),
+                )
                 self.queue.start_command(cmd)
                 return cmd
+            self.log.info(
+                "disruption command failed validation",
+                reason=cmd.reason,
+                candidates=len(cmd.candidates),
+            )
             self._release_reservation(cmd)
             return None
         if now - self._last_run < self.opts.disruption_poll_seconds:
@@ -112,6 +125,13 @@ class DisruptionController:
             # be handed back (the next reconcile re-reserves)
             for other in commands[1:]:
                 self._release_reservation(other)
+            self.log.debug(
+                "disruption command proposed",
+                method=label,
+                reason=cmd.reason,
+                decision=cmd.decision,
+                candidates=len(cmd.candidates),
+            )
             self._pending_validation = (now, cmd)
             return None
         # nothing to do: the cluster is consolidated (cluster.go:550)

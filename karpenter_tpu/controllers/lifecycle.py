@@ -25,7 +25,7 @@ from karpenter_tpu.controllers.kube import Conflict, NotFound, SimKube
 from karpenter_tpu.controllers.state import UNREGISTERED_TAINT, Cluster
 from karpenter_tpu.events import Event, Recorder
 from karpenter_tpu.options import Options
-from karpenter_tpu import metrics
+from karpenter_tpu import logging, metrics
 
 TERMINATION_FINALIZER = well_known.TERMINATION_FINALIZER
 
@@ -65,6 +65,7 @@ class NodeClaimLifecycle:
         self._first_seen: dict[str, float] = {}
         # optional hook: nodepool registration-health ring buffer
         self.registration_health = None
+        self.log = logging.root.named("nodeclaim.lifecycle")
 
     def reconcile_all(self) -> None:
         for claim in self.kube.list("NodeClaim"):
@@ -115,6 +116,11 @@ class NodeClaimLifecycle:
         claim.status.image_id = launched.status.image_id
         claim.status.conditions[COND_LAUNCHED] = "True"
         self._update(claim)
+        self.log.info(
+            "launched nodeclaim",
+            nodeclaim=claim.name,
+            provider_id=claim.status.provider_id,
+        )
         return "launched"
 
     def _register(self, claim: NodeClaim) -> Optional[str]:
@@ -175,6 +181,10 @@ class NodeClaimLifecycle:
         age = self.clock.now() - first
         launched = claim.status.conditions.get(COND_LAUNCHED) == "True"
         if not launched and age > self.opts.launch_ttl_seconds:
+            self.log.warn(
+                "liveness TTL exceeded before launch; deleting nodeclaim",
+                nodeclaim=claim.name, age_seconds=round(age, 1),
+            )
             self.kube.delete("NodeClaim", claim.name)
             self.recorder.publish(
                 Event(
@@ -184,6 +194,10 @@ class NodeClaimLifecycle:
             )
             return "liveness-deleted"
         if launched and age > self.opts.registration_ttl_seconds:
+            self.log.warn(
+                "liveness TTL exceeded before registration; deleting nodeclaim",
+                nodeclaim=claim.name, age_seconds=round(age, 1),
+            )
             self.kube.delete("NodeClaim", claim.name)
             self.recorder.publish(
                 Event(

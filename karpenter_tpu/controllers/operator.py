@@ -63,6 +63,12 @@ class Operator:
     ):
         self.clock = clock or FakeClock()
         self.opts = options or Options()
+        # structured logging (reference operator/logging/logging.go): one
+        # JSON-lines root, level from options, timestamps from the sim clock
+        from karpenter_tpu import logging as klog
+
+        klog.root.set_level(self.opts.log_level)
+        klog.root.set_clock(self.clock)
         self.kube = SimKube(self.clock)
         self.cluster = Cluster(self.clock)
         wire_informers(self.kube, self.cluster)
@@ -137,6 +143,15 @@ class Operator:
             if self.opts.feature_gates.node_overlay
             else None
         )
+        # HTTP probe surface (operator.go:183-221), opt-in via probe_port
+        self.probes = None
+        if self.opts.probe_port is not None:
+            from karpenter_tpu.controllers.probes import ProbeServer
+
+            self.probes = ProbeServer(
+                self.kube, self.cluster, port=self.opts.probe_port
+            )
+            self.probes.start()
         self.node_metrics = NodeMetricsController(self.cluster)
         self.nodepool_metrics = NodePoolMetricsController(self.kube)
         self.pod_metrics = PodMetricsController(self.kube, self.cluster, self.clock)

@@ -1,0 +1,79 @@
+"""Health/readiness probes + metrics endpoint: the operator's HTTP surface.
+
+Reference /root/reference/pkg/operator/operator.go:183-221: the manager
+serves /healthz and /readyz (readiness gated on the informers/CRDs being
+synced) plus the Prometheus registry on the metrics port. This framework's
+single-process operator starts the same three endpoints on a background
+thread when `Options.probe_port` is set (port 0 picks a free one):
+
+- /healthz  — liveness: the process serves requests.
+- /readyz   — readiness: the cluster-state cache is synced with the store
+  (the same barrier every controller takes before acting, cluster.go:118).
+- /metrics  — the Prometheus-style exposition of karpenter_tpu.metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from karpenter_tpu import metrics
+
+
+class ProbeServer:
+    def __init__(self, kube, cluster, port: int = 0, host: str = "127.0.0.1"):
+        self.kube = kube
+        self.cluster = cluster
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> None:
+        kube, cluster = self.kube, self.cluster
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, body: str, ctype="text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, "ok")
+                elif self.path == "/readyz":
+                    try:
+                        ready = cluster.synced(kube)
+                    except Exception:
+                        ready = False
+                    self._reply(200 if ready else 503, "ok" if ready else "state not synced")
+                elif self.path == "/metrics":
+                    try:
+                        body = metrics.REGISTRY.render()
+                    except Exception as e:  # registry mutating mid-render
+                        self._reply(503, f"metrics unavailable: {e}")
+                        return
+                    self._reply(200, body, ctype="text/plain; version=0.0.4")
+                else:
+                    self._reply(404, "not found")
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -19,7 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
-from karpenter_tpu import metrics
+from karpenter_tpu import logging, metrics
 from karpenter_tpu.api import labels as well_known
 from karpenter_tpu.api.objects import (
     NodeClaim,
@@ -195,6 +195,7 @@ class Provisioner:
             self.opts.batch_max_duration_seconds,
         )
         self.force_oracle = force_oracle
+        self.log = logging.root.named("provisioner")
         self.last_solver_used: Optional[str] = None
 
     # -- triggers (provisioning/controller.go:44) ------------------------
@@ -271,6 +272,15 @@ class Provisioner:
             QUEUE_DEPTH.set(0.0)
         created = self.create_node_claims(results)
         bound = self._bind_to_existing(results)
+        self.log.info(
+            "provisioning round complete",
+            pods=len(pods),
+            new_claims=len(created),
+            bound_to_existing=len(bound),
+            errors=len(results.pod_errors),
+            solver=self.last_solver_used,
+            timed_out=results.timed_out,
+        )
         UNSCHEDULABLE_PODS.set(float(len(results.pod_errors)), {"state": "unschedulable"})
         for uid, reason in results.pod_errors.items():
             pod = next((p for p in pods if p.uid == uid), None)

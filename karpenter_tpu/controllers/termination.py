@@ -22,7 +22,7 @@ from karpenter_tpu.cloudprovider.types import NodeClaimNotFoundError
 from karpenter_tpu.controllers.kube import Conflict, NotFound, SimKube
 from karpenter_tpu.controllers.state import DISRUPTED_TAINT, Cluster
 from karpenter_tpu.events import Event, Recorder
-from karpenter_tpu import metrics
+from karpenter_tpu import logging, metrics
 
 NODES_DRAINED = metrics.REGISTRY.counter(
     "karpenter_nodes_drained_total", "Nodes fully drained by termination.", ("nodepool",)
@@ -60,6 +60,7 @@ class NodeTermination:
         self.cloud = cloud_provider
         self.clock = clock
         self.recorder = recorder or Recorder(clock)
+        self.log = logging.root.named("node.termination")
 
     def reconcile_all(self) -> None:
         for node in self.kube.list("Node"):
@@ -147,6 +148,7 @@ class NodeTermination:
         self.recorder.publish(
             Event("Node", name, "Normal", "Terminated", "node drained and removed")
         )
+        self.log.info("terminated node", node=name, nodepool=nodepool)
         return "terminated"
 
     # -- eviction ---------------------------------------------------------

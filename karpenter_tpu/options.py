@@ -69,6 +69,9 @@ class Options:
     kube_client_burst: int = 300
     # observability
     log_level: str = "info"
+    # start the /healthz /readyz /metrics HTTP surface on this port when
+    # set (0 = pick a free port); None = no HTTP server (tests, benchmarks)
+    probe_port: "int | None" = None
     enable_profiling: bool = False
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
 
@@ -92,6 +95,7 @@ class Options:
         f("KARPENTER_KUBE_CLIENT_QPS", int, "kube_client_qps")
         f("KARPENTER_KUBE_CLIENT_BURST", int, "kube_client_burst")
         f("KARPENTER_LOG_LEVEL", str, "log_level")
+        f("KARPENTER_PROBE_PORT", int, "probe_port")
         gates = env.get("KARPENTER_FEATURE_GATES")
         if gates:
             opts.feature_gates = FeatureGates.parse(gates)
