@@ -171,6 +171,19 @@ class Operator:
 
         self.kube.subscribe(triggers)
 
+    def stop(self) -> None:
+        """Release process-level resources: the probe HTTP server's socket
+        and thread, and the global logger's reference to this sim's clock.
+        Like the reference's one-manager-per-process model, logging config
+        (level) is process-global — two concurrent Operators share it."""
+        if self.probes is not None:
+            self.probes.stop()
+            self.probes = None
+        from karpenter_tpu import logging as klog
+
+        if klog.root._clock is self.clock:
+            klog.root.set_clock(None)
+
     # -- loop -------------------------------------------------------------
 
     def step(self, advance_seconds: float = 1.0) -> None:

@@ -602,3 +602,77 @@ def test_spot_to_spot_consolidation_floor():
     op, snc = build(True, [8, 16])
     cmds = snc.compute_commands()
     assert not any(c.replacements for c in cmds), "below the 15-type floor"
+
+
+def test_when_empty_policy_blocks_underutilized_consolidation():
+    """consolidationPolicy=WhenEmpty (nodepool.go): non-empty nodes are not
+    consolidation candidates even when underutilized; empty nodes still
+    are."""
+    from karpenter_tpu.api.objects import Budget
+
+    op = Operator(clock=FakeClock(), force_oracle=True)
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 32])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    fixtures.reset_rng(21)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="default", budgets=[Budget(nodes="100%")]),
+    )
+    fixtures.make_underutilized_fleet(op, 4)
+    np_ = op.kube.list("NodePool")[0]
+    np_.disruption.consolidation_policy = "WhenEmpty"
+    op.kube.update("NodePool", np_)
+    op.clock.advance(26.0)
+    op.pod_events.reconcile_all()
+    op.claim_conditions.reconcile_all()
+    before = {n.name for n in op.kube.list("Node")}
+    assert len(before) >= 4
+    for _ in range(30):
+        op.step(2.0)
+    assert {n.name for n in op.kube.list("Node")} == before, (
+        "WhenEmpty must not consolidate nodes that still hold pods"
+    )
+    # the same under-utilized fleet with the default policy DOES shrink
+    np_ = op.kube.list("NodePool")[0]
+    np_.disruption.consolidation_policy = "WhenEmptyOrUnderutilized"
+    op.kube.update("NodePool", np_)
+    for _ in range(60):
+        op.step(2.0)
+        if len(op.kube.list("Node")) < len(before):
+            break
+    assert len(op.kube.list("Node")) < len(before)
+
+
+def test_budget_reasons_filter():
+    """nodepool.go Budget.Reasons: a zero budget scoped to 'drifted' blocks
+    drift replacement but leaves emptiness free to act."""
+    from karpenter_tpu.api.objects import Budget
+
+    op = settled_operator(n_pods=3)
+    np_ = op.kube.list("NodePool")[0]
+    np_.disruption.budgets = [
+        Budget(nodes="0", reasons=["drifted"]),
+        Budget(nodes="100%", reasons=["empty", "underutilized"]),
+    ]
+    np_.template.labels["fleet"] = "v2"  # drift everything
+    op.kube.update("NodePool", np_)
+    op.nodepool_hash.reconcile_all()
+    mark_consolidatable(op)
+    op.claim_conditions.reconcile_all()
+    old_names = {c.name for c in op.kube.list("NodeClaim")}
+    for _ in range(40):
+        op.step(2.0)
+    # drift is budget-blocked: the drifted claims survive
+    assert old_names <= {c.name for c in op.kube.list("NodeClaim")}, (
+        "a zero drifted-budget must block drift replacement"
+    )
+
+    # but emptiness still works under its own budget: empty the nodes
+    for p in op.kube.list("Pod"):
+        op.kube.delete("Pod", p.name)
+    mark_consolidatable(op)
+    for _ in range(40):
+        op.step(2.0)
+        if not op.kube.list("NodeClaim"):
+            break
+    assert not op.kube.list("NodeClaim"), "emptiness budget was 100%"

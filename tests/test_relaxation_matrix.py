@@ -1,0 +1,279 @@
+"""The preference-relaxation scenario matrix: the reference's suite_test.go
+relaxation families (preferences.go:38-161) driven through the HYBRID
+dispatch — so every scenario also exercises the per-pod partitioning (the
+relaxable pod rides the oracle continuation against the kernel's state).
+
+Ladder order under test (preferences.go:38 Relax):
+  1. drop a required node-affinity OR-term (when >1 remain)
+  2. drop the highest-weight preferred pod affinity
+  3. drop the highest-weight preferred pod anti-affinity
+  4. drop the highest-weight preferred node affinity
+  5. drop a ScheduleAnyway topology spread constraint
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import (
+    LabelSelector,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Operator,
+    PodAffinityTerm,
+    PreferredSchedulingTerm,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+    WhenUnsatisfiable,
+)
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.solver import HybridScheduler, Scheduler, Topology
+from karpenter_tpu.testing import fixtures
+
+ZONE = well_known.TOPOLOGY_ZONE_LABEL_KEY
+HOSTNAME = well_known.HOSTNAME_LABEL_KEY
+
+
+def solve_both(pods_fn, pools_fn=None):
+    """Oracle and hybrid must agree on errors and pod placement counts."""
+    outs = []
+    for cls in (Scheduler, HybridScheduler):
+        fixtures.reset_rng(17)
+        its = construct_instance_types(sizes=[2, 8])
+        pools = pools_fn() if pools_fn else [fixtures.node_pool(name="default")]
+        pods = pods_fn()
+        topo = Topology(pools, {np.name: its for np in pools}, pods)
+        s = cls(pools, {np.name: its for np in pools}, topo)
+        outs.append((s.solve(pods), pods, s))
+    (orc, orc_pods, _), (hyb, hyb_pods, hs) = outs
+    orc_names = {p.uid: p.name for p in orc_pods}
+    hyb_names = {p.uid: p.name for p in hyb_pods}
+    assert {orc_names[u] for u in orc.pod_errors} == {
+        hyb_names[u] for u in hyb.pod_errors
+    }
+    return orc, hyb, hs
+
+
+def base_pods(n=4):
+    return [
+        fixtures.pod(name=f"base-{i}", requests={"cpu": "200m"})
+        for i in range(n)
+    ]
+
+
+# -- rung 1: required node-affinity OR-terms ---------------------------------
+
+
+def test_unsatisfiable_first_affinity_term_relaxes_to_second():
+    """Term[0] matches nothing; term[1] is satisfiable — the reference
+    keeps only term[0] initially, then drops it on failure."""
+
+    def pods():
+        p = fixtures.pod(name="multi-term", requests={"cpu": "100m"})
+        p.node_affinity = NodeAffinity(
+            required_terms=[
+                NodeSelectorTerm(
+                    match_expressions=[
+                        NodeSelectorRequirement(ZONE, Operator.IN, ["no-such-zone"])
+                    ]
+                ),
+                NodeSelectorTerm(
+                    match_expressions=[
+                        NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-b"])
+                    ]
+                ),
+            ]
+        )
+        return base_pods() + [p]
+
+    orc, hyb, hs = solve_both(pods)
+    assert not orc.pod_errors
+    assert hyb.pod_errors == {}
+    assert hs.used_tpu is True  # the base pods rode the kernel
+
+
+def test_single_unsatisfiable_required_term_fails():
+    """One required term, unsatisfiable: relaxation cannot drop the last
+    term; the pod must error on both paths."""
+
+    def pods():
+        p = fixtures.pod(name="stuck", requests={"cpu": "100m"})
+        p.node_affinity = NodeAffinity(
+            required_terms=[
+                NodeSelectorTerm(
+                    match_expressions=[
+                        NodeSelectorRequirement(ZONE, Operator.IN, ["no-such-zone"])
+                    ]
+                )
+            ]
+        )
+        return base_pods() + [p]
+
+    orc, hyb, _ = solve_both(pods)
+    assert len(orc.pod_errors) == 1
+
+
+# -- rungs 2-3: preferred pod (anti-)affinity --------------------------------
+
+
+@pytest.mark.parametrize("anti", [False, True])
+def test_unsatisfiable_preferred_pod_affinity_drops(anti):
+    """A preferred (anti-)affinity to a label that exists on every base pod
+    (anti) / no pod (affinity) would block scheduling if required; as a
+    preference it relaxes away and everything lands."""
+
+    def pods():
+        out = []
+        for i, p in enumerate(base_pods()):
+            p.metadata.labels["app"] = "base"
+            out.append(p)
+        p = fixtures.pod(name="pref", labels={"app": "base"}, requests={"cpu": "100m"})
+        term = WeightedPodAffinityTerm(
+            weight=100,
+            term=PodAffinityTerm(
+                topology_key=HOSTNAME,
+                label_selector=LabelSelector(match_labels={"app": "base"}),
+            ),
+        )
+        if anti:
+            p.pod_anti_affinity_preferred = [term]
+        else:
+            p.pod_affinity_preferred = [
+                WeightedPodAffinityTerm(
+                    weight=100,
+                    term=PodAffinityTerm(
+                        topology_key=HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "missing"}),
+                    ),
+                )
+            ]
+        out.append(p)
+        return out
+
+    orc, hyb, hs = solve_both(pods)
+    assert not orc.pod_errors and not hyb.pod_errors
+    assert hs.used_tpu is True
+    assert hs.fallback_reason and "continued on the oracle" in hs.fallback_reason
+
+
+def test_weighted_preferences_drop_highest_first():
+    """preferences.go:85: among several preferred terms the HIGHEST weight
+    drops first; a low-weight satisfiable preference plus a high-weight
+    unsatisfiable one still schedules."""
+
+    def pods():
+        p = fixtures.pod(name="weighted", labels={"app": "w"}, requests={"cpu": "100m"})
+        p.pod_affinity_preferred = [
+            WeightedPodAffinityTerm(
+                weight=90,
+                term=PodAffinityTerm(
+                    topology_key=ZONE,
+                    label_selector=LabelSelector(match_labels={"app": "missing"}),
+                ),
+            ),
+            WeightedPodAffinityTerm(
+                weight=10,
+                term=PodAffinityTerm(
+                    topology_key=ZONE,
+                    label_selector=LabelSelector(match_labels={"app": "w"}),
+                ),
+            ),
+        ]
+        return base_pods() + [p]
+
+    orc, hyb, _ = solve_both(pods)
+    assert not orc.pod_errors and not hyb.pod_errors
+
+
+# -- rung 4: preferred node affinity -----------------------------------------
+
+
+@pytest.mark.parametrize("satisfiable", [True, False])
+def test_preferred_node_affinity(satisfiable):
+    def pods():
+        p = fixtures.pod(name="nodepref", requests={"cpu": "100m"})
+        zone = "test-zone-a" if satisfiable else "no-such-zone"
+        p.node_affinity = NodeAffinity(
+            preferred=[
+                PreferredSchedulingTerm(
+                    weight=1,
+                    preference=NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(ZONE, Operator.IN, [zone])
+                        ]
+                    ),
+                )
+            ]
+        )
+        return base_pods() + [p]
+
+    orc, hyb, _ = solve_both(pods)
+    assert not orc.pod_errors and not hyb.pod_errors
+
+
+# -- rung 5: ScheduleAnyway spread -------------------------------------------
+
+
+@pytest.mark.parametrize("n", [6, 12])
+def test_schedule_anyway_mixed_batch(n):
+    """ScheduleAnyway pods in a mostly-supported batch: the bulk rides the
+    kernel, the relaxable tail lands via the continuation, nothing errors."""
+
+    def pods():
+        out = base_pods(n)
+        for i in range(3):
+            out.append(
+                fixtures.pod(
+                    name=f"anyway-{i}",
+                    labels={"app": "sa"},
+                    requests={"cpu": "100m"},
+                    topology_spread_constraints=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=ZONE,
+                            when_unsatisfiable=WhenUnsatisfiable.SCHEDULE_ANYWAY,
+                            label_selector=LabelSelector(match_labels={"app": "sa"}),
+                        )
+                    ],
+                )
+            )
+        return out
+
+    orc, hyb, hs = solve_both(pods)
+    assert not orc.pod_errors and not hyb.pod_errors
+    assert hs.used_tpu is True
+
+
+# -- the reference's preference benchmark mix --------------------------------
+
+
+@pytest.mark.parametrize("n", [10, 25])
+def test_preference_mix_all_schedule(n):
+    """makePreferencePods (scheduling_benchmark_test.go:378): every pod has
+    one unsatisfiable and one satisfiable preference; all must land."""
+
+    def pods():
+        return fixtures.make_preference_pods(n)
+
+    orc, hyb, _ = solve_both(pods)
+    assert not orc.pod_errors and not hyb.pod_errors
+
+
+def test_ignore_preferences_policy_matches_oracle():
+    """PreferencePolicy=Ignore (scheduler.go:74): preferences are stripped
+    up front on both paths."""
+    from karpenter_tpu.solver.oracle import SchedulerOptions
+
+    fixtures.reset_rng(17)
+    its = construct_instance_types(sizes=[2, 8])
+    pool = fixtures.node_pool(name="default")
+    pods = fixtures.make_preference_pods(8)
+    topo = Topology([pool], {"default": its}, pods, ignore_preferences=True)
+    s = Scheduler(
+        [pool], {"default": its}, topo,
+        options=SchedulerOptions(ignore_preferences=True),
+    )
+    r = s.solve(pods)
+    assert not r.pod_errors
