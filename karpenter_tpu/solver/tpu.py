@@ -590,6 +590,7 @@ class TpuScheduler:
         # many claims share identical requirement rows (same class/template/
         # domain) — decode each distinct row once and copy
         row_cache: dict[bytes, Requirements] = {}
+        live_cache: dict[bytes, list] = {}
 
         def decode_cached(slot: int) -> Requirements:
             key = b"".join(np.ascontiguousarray(a[slot]).tobytes() for a in creq)
@@ -606,8 +607,15 @@ class TpuScheduler:
             claim.template = nct
             claim.hostname = f"hostname-placeholder-{next(_claim_seq):04d}"
             claim.requirements = decode_cached(slot)
-            live_idx = np.flatnonzero(alive_bits[slot])
-            live = [types_by_id[ordered_types[i]] for i in live_idx]
+            # claims of a class/template share surviving-type sets; build
+            # each distinct list once and copy (lists are replaced, never
+            # mutated, downstream)
+            akey = alive_bits[slot].tobytes()
+            live = live_cache.get(akey)
+            if live is None:
+                live_idx = np.flatnonzero(alive_bits[slot])
+                live = [types_by_id[ordered_types[i]] for i in live_idx]
+                live_cache[akey] = live
             claim.instance_type_options = InstanceTypes(live)
             claim.requests = table.decode(crequests[slot])
             claim.daemon_resources = scheduler.daemon_overhead[nct]

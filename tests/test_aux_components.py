@@ -279,3 +279,20 @@ def test_probe_server_endpoints():
         assert code == 503 and "not synced" in body
     finally:
         srv.stop()
+
+
+def test_operator_stop_releases_probe_port_and_clock():
+    """Operator.stop(): the probe socket/thread are released (a second
+    operator can bind the SAME port) and the global logger's sim clock is
+    detached."""
+    from karpenter_tpu import logging as klog
+    from karpenter_tpu.options import Options
+
+    op = Op(clock=FakeClock(), force_oracle=True, options=Options(probe_port=0))
+    port = op.probes.port
+    op.stop()
+    assert op.probes is None
+    assert klog.root._clock is None
+    op2 = Op(clock=FakeClock(), force_oracle=True, options=Options(probe_port=port))
+    assert op2.probes.port == port
+    op2.stop()

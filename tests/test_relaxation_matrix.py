@@ -263,17 +263,26 @@ def test_preference_mix_all_schedule(n):
 
 def test_ignore_preferences_policy_matches_oracle():
     """PreferencePolicy=Ignore (scheduler.go:74): preferences are stripped
-    up front on both paths."""
+    up front; the tensor encoding gates this policy, so the hybrid must
+    fall back to the oracle wholesale and match it."""
     from karpenter_tpu.solver.oracle import SchedulerOptions
 
-    fixtures.reset_rng(17)
-    its = construct_instance_types(sizes=[2, 8])
-    pool = fixtures.node_pool(name="default")
-    pods = fixtures.make_preference_pods(8)
-    topo = Topology([pool], {"default": its}, pods, ignore_preferences=True)
-    s = Scheduler(
-        [pool], {"default": its}, topo,
-        options=SchedulerOptions(ignore_preferences=True),
+    results = []
+    for cls in (Scheduler, HybridScheduler):
+        fixtures.reset_rng(17)
+        its = construct_instance_types(sizes=[2, 8])
+        pool = fixtures.node_pool(name="default")
+        pods = fixtures.make_preference_pods(8)
+        topo = Topology([pool], {"default": its}, pods, ignore_preferences=True)
+        s = cls(
+            [pool], {"default": its}, topo,
+            options=SchedulerOptions(ignore_preferences=True),
+        )
+        results.append((s.solve(pods), s))
+    (orc, _), (hyb, hs) = results
+    assert not orc.pod_errors and not hyb.pod_errors
+    assert hs.used_tpu is False  # the encode gates PreferencePolicy=Ignore
+    parts = lambda r: sorted(
+        tuple(sorted(p.name for p in c.pods)) for c in r.new_node_claims if c.pods
     )
-    r = s.solve(pods)
-    assert not r.pod_errors
+    assert parts(orc) == parts(hyb)
