@@ -272,7 +272,7 @@ def make_preference_pods(count: int) -> list[Pod]:
     return out
 
 
-def make_underutilized_fleet(op, n_nodes: int, rider_requests=None, max_ticks=200):
+def make_underutilized_fleet(op, n_nodes: int, rider_requests=None, max_ticks=200, seed_requests=None):
     """Provision `n_nodes` one-pod nodes through the real control plane
     (hostname anti-affinity forces one node per seed pod), then swap each
     seed for a small bound RUNNING rider — the classic multi-node
@@ -286,7 +286,7 @@ def make_underutilized_fleet(op, n_nodes: int, rider_requests=None, max_ticks=20
         p = pod(
             name=f"seed-{i}",
             labels={"fleet": "seed"},
-            requests={"cpu": "700m", "memory": "512Mi"},
+            requests=dict(seed_requests or {"cpu": "700m", "memory": "512Mi"}),
             pod_anti_requirements=[
                 PodAffinityTerm(
                     topology_key=well_known.HOSTNAME_LABEL_KEY,

@@ -676,3 +676,76 @@ def test_budget_reasons_filter():
         if not op.kube.list("NodeClaim"):
             break
     assert not op.kube.list("NodeClaim"), "emptiness budget was 100%"
+
+
+def test_orchestration_rollback_on_replacement_failure():
+    """queue.go:181 waitOrTerminate: when a replacement NodeClaim dies
+    before initializing (liveness), the command rolls back — the original
+    nodes are un-tainted, un-marked, and keep running."""
+    from karpenter_tpu.api.objects import Budget
+    from karpenter_tpu.controllers.state import DISRUPTED_TAINT
+    from karpenter_tpu.options import FeatureGates, Options
+
+    op = Operator(
+        clock=FakeClock(),
+        force_oracle=True,
+        # KWOK seeds land on spot; replacing all five needs the gate
+        options=Options(
+            feature_gates=FeatureGates(spot_to_spot_consolidation=True)
+        ),
+    )
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 8])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    fixtures.reset_rng(21)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="default", budgets=[Budget(nodes="100%")]),
+    )
+    # five OVERSIZED (8-cpu) nodes with small riders: removing all five
+    # needs one fresh 8-cpu node, strictly cheaper than five -> REPLACE
+    fixtures.make_underutilized_fleet(
+        op, 5,
+        rider_requests={"cpu": "1200m"},
+        seed_requests={"cpu": "7", "memory": "6Gi"},
+    )
+    op.clock.advance(26.0)
+    op.pod_events.reconcile_all()
+    op.claim_conditions.reconcile_all()
+    originals = {c.name for c in op.kube.list("NodeClaim")}
+
+    # drive until a replace command starts (replacements created)
+    started = None
+    for _ in range(40):
+        op.disruption._last_run = -1e18  # poll immediately
+        op.step(2.0)
+        if op.disruption.queue.in_flight and op.disruption.queue.in_flight[0].replacement_names:
+            started = op.disruption.queue.in_flight[0]
+            break
+    assert started is not None and started.replacement_names, (
+        "scenario must produce a replace command"
+    )
+    candidate_names = {c.name for c in started.command.candidates}
+
+    # kill the replacement before it initializes (liveness analog)
+    for name in started.replacement_names:
+        op.kube.delete("NodeClaim", name)
+        # force-complete the delete (strip finalizers) like GC would
+        claim = op.kube.try_get("NodeClaim", name)
+        if claim is not None:
+            claim.metadata.finalizers = []
+            try:
+                op.kube.update("NodeClaim", claim)
+            except Exception:
+                pass
+
+    op.disruption.queue.reconcile()
+    # rollback: originals survive, no disruption taints, unmarked
+    still = {c.name for c in op.kube.list("NodeClaim")}
+    assert candidate_names <= still, "rollback must keep the originals"
+    for c in started.command.candidates:
+        node = op.kube.try_get("Node", c.name)
+        assert node is not None
+        assert DISRUPTED_TAINT not in node.taints, "taint must roll back"
+        sn = op.cluster.node_by_name(c.name)
+        assert sn is not None and not sn.marked_for_deletion
+    assert not op.disruption.queue.busy
