@@ -27,6 +27,16 @@ sequential method.
 Gates (fall back to the sequential prefix scan when violated): nodepool
 limits, reserved capacity — anything where per-prefix state diverges
 beyond availability and topology counts.
+
+Measured honestly (BENCH_DETAIL.json c4): the vmapped scan currently LOSES
+to the sequential binary search (~5x at 1-2k nodes) because vmap batches
+the kernel's inner control flow into execute-both-branches selects and
+multiplies every per-step tensor by the prefix count; routing the batch
+through the bulk run kernel was tried and measured WORSE for the same
+reason (~10 all-branch bulk iterations x 100-wide operands). The honest
+default strategy therefore stays "binary" (consolidation.py); this module
+is the capability + its conformance harness, and the path to making it win
+is a dedicated batched kernel without per-element control flow.
 """
 
 from __future__ import annotations
