@@ -196,6 +196,9 @@ class TopologySpreadConstraint:
     min_domains: Optional[int] = None
     node_affinity_policy: NodeInclusionPolicy = NodeInclusionPolicy.HONOR
     node_taints_policy: NodeInclusionPolicy = NodeInclusionPolicy.IGNORE
+    # each key's value from the POD's labels folds into the selector as an
+    # In requirement (topology.go:434) — per-deployment spread isolation
+    match_label_keys: list[str] = field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------

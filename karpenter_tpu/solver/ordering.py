@@ -109,6 +109,10 @@ def pod_class_key(pod: Pod) -> tuple:
                     t.label_selector is not None
                     and t.label_selector.matches(pod.metadata.labels)
                 ),
+                tuple(
+                    (k, pod.metadata.labels.get(k))
+                    for k in getattr(t, "match_label_keys", ())
+                ),
             )
             for t in pod.topology_spread_constraints
         ),

@@ -24,6 +24,7 @@ from typing import Callable, Iterable, Optional
 from karpenter_tpu.api import labels as well_known
 from karpenter_tpu.api.objects import (
     LabelSelector,
+    LabelSelectorRequirement,
     NodeInclusionPolicy,
     Operator,
     Pod,
@@ -563,13 +564,36 @@ class Topology:
                 and tsc.when_unsatisfiable != WhenUnsatisfiable.DO_NOT_SCHEDULE
             ):
                 continue
+            selector = tsc.label_selector
+            if tsc.match_label_keys:
+                # topology.go:434: fold the pod's own values for each
+                # matchLabelKeys entry into the selector as In expressions,
+                # scoping the spread to pods sharing those values (e.g. one
+                # group per deployment revision)
+                extra = [
+                    LabelSelectorRequirement(
+                        key=k, operator=Operator.IN, values=[pod.metadata.labels[k]]
+                    )
+                    for k in tsc.match_label_keys
+                    if k in pod.metadata.labels
+                ]
+                if extra:
+                    selector = LabelSelector(
+                        match_labels=dict(selector.match_labels)
+                        if selector
+                        else {},
+                        match_expressions=(
+                            list(selector.match_expressions) if selector else []
+                        )
+                        + extra,
+                    )
             groups.append(
                 TopologyGroup(
                     TopologyType.SPREAD,
                     tsc.topology_key,
                     pod,
                     frozenset({pod.namespace}),
-                    tsc.label_selector,
+                    selector,
                     tsc.max_skew,
                     tsc.min_domains,
                     tsc.node_taints_policy,

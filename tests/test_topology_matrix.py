@@ -666,3 +666,96 @@ def test_capacity_type_spread_with_ct_requirement(ct):
     r = run_parity(problem(pods), expect_errors=expect_errors)
     if not expect_errors:
         assert not r.pod_errors
+
+
+# ---------------------------------------------------------------------------
+# 10. matchLabelKeys (topology.go:434)
+
+
+def test_match_label_keys_isolates_groups():
+    """Two 'deployments' sharing a selector label but differing in the
+    matchLabelKeys label spread INDEPENDENTLY: each group gets its own
+    counts, so 8 pods (4+4) land 1-per-zone per group, not 2-per-zone
+    combined."""
+
+    def pods():
+        out = []
+        for rev in ("a", "b"):
+            for i in range(4):
+                out.append(
+                    fixtures.pod(
+                        name=f"mlk-{rev}-{i}",
+                        labels={"app": "web", "rev": rev},
+                        requests={"cpu": "100m"},
+                        topology_spread_constraints=[
+                            TopologySpreadConstraint(
+                                max_skew=1,
+                                topology_key=ZONE,
+                                when_unsatisfiable=WhenUnsatisfiable.DO_NOT_SCHEDULE,
+                                label_selector=LabelSelector(
+                                    match_labels={"app": "web"}
+                                ),
+                                match_label_keys=["rev"],
+                            )
+                        ],
+                    )
+                )
+        return out
+
+    r = run_parity(problem(pods))
+    assert not r.pod_errors
+    # pin the isolation mechanism: the two revisions must form TWO distinct
+    # topology groups whose folded selectors differ by the rev value
+    fixtures.reset_rng(42)
+    its = construct_instance_types(sizes=[2, 8])
+    pool = fixtures.node_pool(name="default")
+    pod_list = pods()
+    topo = Topology([pool], {"default": its}, pod_list)
+    assert len(topo.topology_groups) == 2, (
+        "matchLabelKeys must split the spread into per-revision groups"
+    )
+    selectors = sorted(
+        str(
+            next(
+                e.values
+                for e in tg.selector.match_expressions
+                if e.key == "rev"
+            )
+        )
+        for tg in topo.topology_groups.values()
+    )
+    assert selectors == ["['a']", "['b']"]
+
+
+def test_match_label_keys_missing_label_ignored():
+    """A matchLabelKeys entry absent from the pod's labels folds nothing in
+    (reference: the `if value, ok` guard) — such pods share ONE group with
+    plain spread pods of the same selector."""
+
+    def pods():
+        return spread_pods(6, key=ZONE) + [
+            fixtures.pod(
+                name=f"nolabel-{i}",
+                labels={"app": "web"},
+                requests={"cpu": "100m"},
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=ZONE,
+                        when_unsatisfiable=WhenUnsatisfiable.DO_NOT_SCHEDULE,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                        match_label_keys=["no-such-label"],
+                    )
+                ],
+            )
+            for i in range(6)
+        ]
+
+    r = run_parity(problem(pods))
+    assert not r.pod_errors
+    # nothing folded -> structurally identical constraint -> ONE group
+    fixtures.reset_rng(42)
+    its = construct_instance_types(sizes=[2, 8])
+    pool = fixtures.node_pool(name="default")
+    topo = Topology([pool], {"default": its}, pods())
+    assert len(topo.topology_groups) == 1
