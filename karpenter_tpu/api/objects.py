@@ -169,6 +169,9 @@ class PodAffinityTerm:
     topology_key: str
     label_selector: LabelSelector = field(default_factory=LabelSelector)
     namespaces: list[str] = field(default_factory=list)  # empty = pod's namespace
+    # selects namespaces by their labels; union with `namespaces`
+    # (reference topology.go:503 buildNamespaceList)
+    namespace_selector: Optional[LabelSelector] = None
 
 
 @dataclass

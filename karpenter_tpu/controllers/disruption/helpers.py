@@ -17,11 +17,10 @@ from karpenter_tpu.api import labels as well_known
 from karpenter_tpu.api.objects import Pod
 from karpenter_tpu.cloudprovider.types import MAX_FLOAT
 from karpenter_tpu.controllers.disruption.types import Candidate, disruption_cost
-from karpenter_tpu.controllers.state import Cluster, is_reschedulable
+from karpenter_tpu.controllers.state import Cluster, cluster_source, is_reschedulable
 from karpenter_tpu.options import Options
 from karpenter_tpu.scheduling import Requirements
 from karpenter_tpu.solver import HybridScheduler, Results, SchedulerOptions, Topology
-from karpenter_tpu.solver.topology import ClusterSource
 from karpenter_tpu.utils.pdb import PDBLimits
 
 
@@ -82,21 +81,12 @@ def simulate_scheduling(
         for v in cluster.schedulable_node_views()
         if v.name not in candidate_names
     ]
-    pods_by_ns: dict[str, list[Pod]] = {}
-    for p in cluster.pods.values():
-        if cluster.bindings.get(p.uid) in candidate_names:
-            continue  # pods on removed nodes aren't "scheduled" in the sim
-        pods_by_ns.setdefault(p.namespace, []).append(p)
-    nodes_by_name = {
-        sn.name: sn.node
-        for sn in cluster.state_nodes()
-        if sn.node is not None and sn.name not in candidate_names
-    }
+    # pods on removed nodes aren't "scheduled" in the sim
     topology = Topology(
         node_pools,
         its_by_pool,
         pods,
-        cluster=ClusterSource(pods_by_ns, nodes_by_name),
+        cluster=cluster_source(kube, cluster, frozenset(candidate_names)),
         state_node_views=views,
     )
     scheduler = HybridScheduler(

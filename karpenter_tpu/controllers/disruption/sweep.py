@@ -49,7 +49,7 @@ import numpy as np
 from karpenter_tpu.api import labels as well_known
 from karpenter_tpu.controllers.disruption.types import Candidate
 from karpenter_tpu.solver.oracle import Scheduler, SchedulerOptions
-from karpenter_tpu.solver.topology import ClusterSource, Topology
+from karpenter_tpu.solver.topology import Topology
 from karpenter_tpu.solver.tpu import TpuScheduler
 from karpenter_tpu.solver.tpu_problem import UnsupportedBySolver, encode_problem
 
@@ -122,17 +122,13 @@ def prefix_feasibility(
         pod_prefix.append(-1)  # valid in every prefix
 
     # full-cluster topology (all nodes, all bound pods)
-    pods_by_ns: dict[str, list] = {}
-    for pd in cluster.pods.values():
-        pods_by_ns.setdefault(pd.namespace, []).append(pd)
-    nodes_by_name = {
-        sn.name: sn.node for sn in cluster.state_nodes() if sn.node is not None
-    }
+    from karpenter_tpu.controllers.state import cluster_source
+
     topology = Topology(
         node_pools,
         its_by_pool,
         pods,
-        cluster=ClusterSource(pods_by_ns, nodes_by_name),
+        cluster=cluster_source(kube, cluster),
         state_node_views=views,
     )
     sched = TpuScheduler(

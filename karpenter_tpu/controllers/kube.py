@@ -62,6 +62,15 @@ class FakeClock:
 
 
 @dataclass
+class Namespace:
+    """Minimal Namespace: name + labels, for affinity namespaceSelector
+    resolution (reference topology.go:503 lists Namespace objects)."""
+
+    name: str
+    labels: dict = field(default_factory=dict)
+
+
+@dataclass
 class DaemonSet:
     """Minimal DaemonSet: the provisioner only needs the pod template for
     daemon overhead computation (reference provisioner.go:477)."""

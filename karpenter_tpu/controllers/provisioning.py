@@ -30,7 +30,7 @@ from karpenter_tpu.api.objects import (
     Pod,
 )
 from karpenter_tpu.controllers.kube import NotFound, SimKube
-from karpenter_tpu.controllers.state import Cluster, is_provisionable, is_reschedulable
+from karpenter_tpu.controllers.state import Cluster, cluster_source, is_provisionable, is_reschedulable
 from karpenter_tpu.events import Event, Recorder
 from karpenter_tpu.options import Options
 from karpenter_tpu.solver import HybridScheduler, Results, SchedulerOptions, Topology
@@ -308,21 +308,12 @@ class Provisioner:
         for p in pods:
             self.volume_topology.inject(p)  # provisioner.go:286
         views = self.cluster.schedulable_node_views()
-        # topology counting sees every scheduled pod in the cluster
-        # (topology.go:328 countDomains)
-        pods_by_ns: dict[str, list[Pod]] = {}
-        for p in self.cluster.pods.values():
-            pods_by_ns.setdefault(p.namespace, []).append(p)
-        nodes_by_name = {
-            sn.name: sn.node for sn in self.cluster.state_nodes() if sn.node is not None
-        }
-        from karpenter_tpu.solver.topology import ClusterSource
-
+        
         topology = Topology(
             node_pools,
             its_by_pool,
             pods,
-            cluster=ClusterSource(pods_by_ns, nodes_by_name),
+            cluster=cluster_source(self.kube, self.cluster),
             state_node_views=views,
             ignore_preferences=self.opts.preference_policy == "Ignore",
         )

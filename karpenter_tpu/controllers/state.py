@@ -597,6 +597,29 @@ class Cluster:
             self.pod_scheduling_decisions[uid] = (node, now)
 
 
+def cluster_source(kube, cluster: "Cluster", exclude_nodes: frozenset = frozenset()):
+    """The ClusterSource every scheduling simulation feeds Topology: all
+    scheduled pods by namespace, node objects by name, and namespace labels
+    for affinity namespaceSelector resolution (topology.go:328 countDomains
+    + :503 buildNamespaceList)."""
+    from karpenter_tpu.solver.topology import ClusterSource
+
+    pods_by_ns: dict[str, list[Pod]] = {}
+    for p in cluster.pods.values():
+        if exclude_nodes and cluster.bindings.get(p.uid) in exclude_nodes:
+            continue
+        pods_by_ns.setdefault(p.namespace, []).append(p)
+    nodes_by_name = {
+        sn.name: sn.node
+        for sn in cluster.state_nodes()
+        if sn.node is not None and sn.name not in exclude_nodes
+    }
+    namespace_labels = {
+        ns.name: dict(ns.labels) for ns in kube.list("Namespace")
+    }
+    return ClusterSource(pods_by_ns, nodes_by_name, namespace_labels)
+
+
 def wire_informers(kube, cluster: Cluster) -> None:
     """Subscribe the cluster cache to SimKube watch events — the analog of
     the reference's five informer controllers (state/informer/*.go)."""

@@ -196,8 +196,10 @@ def encode_problem_request(
     daemonset_pods=None,
     options: Optional[SchedulerOptions] = None,
     force_oracle: bool = False,
+    namespace_labels: Optional[dict] = None,
 ) -> bytes:
     req = {
+        "namespace_labels": namespace_labels or {},
         "node_pools": codec.to_jsonable(node_pools),
         "instance_types_by_pool": {
             k: codec.to_jsonable(list(v)) for k, v in instance_types_by_pool.items()
@@ -225,6 +227,7 @@ def _decode_problem_request(payload: bytes):
     }
     pods = _decode_pods_flat(req["pods_flat"])
     views = _decode_views(req.get("state_node_views"))
+    namespace_labels = req.get("namespace_labels") or {}
     daemons = codec.from_jsonable(req.get("daemonset_pods") or [])
     o = req.get("options") or {}
     options = SchedulerOptions(
@@ -240,6 +243,7 @@ def _decode_problem_request(payload: bytes):
         daemons,
         options,
         req.get("force_oracle", False),
+        namespace_labels,
     )
 
 
@@ -372,9 +376,16 @@ class SolverServer:
             daemons,
             options,
             force_oracle,
+            namespace_labels,
         ) = _decode_problem_request(payload)
+        from karpenter_tpu.solver.topology import ClusterSource
+
         topology = Topology(
-            node_pools, its_by_pool, pods, state_node_views=views
+            node_pools,
+            its_by_pool,
+            pods,
+            cluster=ClusterSource(namespace_labels=namespace_labels),
+            state_node_views=views,
         )
         scheduler = HybridScheduler(
             node_pools,
@@ -423,6 +434,7 @@ class SolverClient:
         daemonset_pods=None,
         options: Optional[SchedulerOptions] = None,
         force_oracle: bool = False,
+        namespace_labels: Optional[dict] = None,
     ) -> dict:
         payload = encode_problem_request(
             node_pools,
@@ -432,6 +444,7 @@ class SolverClient:
             daemonset_pods,
             options,
             force_oracle,
+            namespace_labels,
         )
         _send_frame(self._sock, KIND_SOLVE, payload)
         kind, resp = _recv_frame(self._sock)

@@ -478,9 +478,12 @@ class ClusterSource:
         self,
         pods_by_namespace: Optional[dict[str, list[Pod]]] = None,
         nodes_by_name: Optional[dict] = None,
+        namespace_labels: Optional[dict[str, dict[str, str]]] = None,
     ):
         self.pods_by_namespace = pods_by_namespace or {}
         self.nodes_by_name = nodes_by_name or {}
+        # namespace name -> labels, for namespaceSelector resolution
+        self.namespace_labels = namespace_labels or {}
 
     def list_pods(self, namespace: str) -> list[Pod]:
         return self.pods_by_namespace.get(namespace, [])
@@ -556,6 +559,25 @@ class Topology:
                 existing = tg
             existing.add_owner(pod.uid)
 
+    def _build_namespace_list(
+        self, pod_namespace: str, term: PodAffinityTerm
+    ) -> frozenset[str]:
+        """topology.go:503 buildNamespaceList: no namespaces and no selector
+        -> the pod's namespace; explicit list without selector -> that list;
+        a selector unions label-matched namespaces with the explicit list."""
+        selector = getattr(term, "namespace_selector", None)
+        if not term.namespaces and selector is None:
+            return frozenset({pod_namespace})
+        if selector is None:
+            return frozenset(term.namespaces)
+        selected = {
+            name
+            for name, labels in self.cluster.namespace_labels.items()
+            if selector.matches(labels)
+        }
+        selected.update(term.namespaces)
+        return frozenset(selected)
+
     def _new_for_topologies(self, pod: Pod) -> list[TopologyGroup]:
         groups = []
         for tsc in pod.topology_spread_constraints:
@@ -619,7 +641,7 @@ class Topology:
                 for w in pod.pod_anti_affinity_preferred
             ]
         for topology_type, term in terms:
-            namespaces = frozenset(term.namespaces or [pod.namespace])
+            namespaces = self._build_namespace_list(pod.namespace, term)
             groups.append(
                 TopologyGroup(
                     topology_type,
@@ -642,7 +664,7 @@ class Topology:
         """Track pods with anti-affinity so we can avoid scheduling their
         targets near them (topology.go:297). Only required terms."""
         for term in pod.pod_anti_affinity:
-            namespaces = frozenset(term.namespaces or [pod.namespace])
+            namespaces = self._build_namespace_list(pod.namespace, term)
             tg = TopologyGroup(
                 TopologyType.POD_ANTI_AFFINITY,
                 term.topology_key,

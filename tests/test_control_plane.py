@@ -236,3 +236,45 @@ def test_cluster_synced_barrier():
     op.kube.create("NodePool", fixtures.node_pool(name="default"))
     op.kube.create("Pod", fixtures.make_generic_pods(1)[0])
     assert op.cluster.synced(op.kube)
+
+
+def test_namespace_selector_wired_through_operator():
+    """Namespace objects in the store reach the scheduling Topology via the
+    shared cluster_source factory: an affinity namespaceSelector resolves
+    against their labels in a real provisioner tick (topology.go:503)."""
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.controllers.kube import Namespace
+
+    op = small_operator()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Namespace", Namespace(name="team-a", labels={"tier": "backend"}))
+    op.kube.create("Namespace", Namespace(name="frontend", labels={"tier": "web"}))
+
+    anchor = fixtures.pod(
+        name="anchor", labels={"db": "primary"}, requests={"cpu": "100m"}
+    )
+    anchor.metadata.namespace = "team-a"
+    op.kube.create("Pod", anchor)
+    follower = fixtures.pod(
+        name="follower",
+        labels={"app": "web"},
+        requests={"cpu": "100m"},
+        pod_requirements=[
+            PodAffinityTerm(
+                topology_key=wk.HOSTNAME_LABEL_KEY,
+                label_selector=LabelSelector(match_labels={"db": "primary"}),
+                namespace_selector=LabelSelector(match_labels={"tier": "backend"}),
+            )
+        ],
+    )
+    follower.metadata.namespace = "frontend"
+    op.kube.create("Pod", follower)
+    op.run_until_settled(max_ticks=60)
+
+    a = op.kube.get("Pod", "anchor")
+    f = op.kube.get("Pod", "follower")
+    assert a.node_name and f.node_name
+    assert a.node_name == f.node_name, (
+        "hostname affinity across a selector-matched namespace must co-locate"
+    )

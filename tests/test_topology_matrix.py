@@ -759,3 +759,80 @@ def test_match_label_keys_missing_label_ignored():
     pool = fixtures.node_pool(name="default")
     topo = Topology([pool], {"default": its}, pods())
     assert len(topo.topology_groups) == 1
+
+
+# ---------------------------------------------------------------------------
+# 11. namespaceSelector on affinity terms (topology.go:503)
+
+
+def test_affinity_namespace_selector_unions_namespaces():
+    """An affinity term's namespaceSelector matches namespaces by LABEL and
+    unions with the explicit list; pods in selected namespaces count as
+    affinity targets across namespaces."""
+    from karpenter_tpu.solver.topology import ClusterSource
+
+    def make():
+        fixtures.reset_rng(42)
+        its = construct_instance_types(sizes=[2, 8])
+        pools = [fixtures.node_pool(name="default")]
+        target_labels = {"db": "primary"}
+        pods = []
+        # anchors in two labeled namespaces
+        for ns in ("team-a", "team-b"):
+            p = fixtures.pod(
+                name=f"anchor-{ns}", labels=dict(target_labels),
+                requests={"cpu": "100m"},
+            )
+            p.metadata.namespace = ns
+            pods.append(p)
+        # followers in a third namespace select tier=backend namespaces
+        for i in range(4):
+            p = fixtures.pod(
+                name=f"follow-{i}", labels={"app": "web"},
+                requests={"cpu": "100m"},
+                pod_requirements=[
+                    PodAffinityTerm(
+                        topology_key=HOSTNAME,
+                        label_selector=LabelSelector(match_labels=dict(target_labels)),
+                        namespace_selector=LabelSelector(
+                            match_labels={"tier": "backend"}
+                        ),
+                    )
+                ],
+            )
+            p.metadata.namespace = "frontend"
+            pods.append(p)
+        cluster = ClusterSource(
+            namespace_labels={
+                "team-a": {"tier": "backend"},
+                "team-b": {"tier": "backend"},
+                "frontend": {"tier": "frontend"},
+                "default": {},
+            }
+        )
+        from karpenter_tpu.solver import Topology
+
+        topo = Topology(pools, {"default": its}, pods, cluster=cluster)
+        return pools, {"default": its}, pods, topo
+
+    # group structure: the followers' affinity group spans BOTH backend
+    # namespaces (selector-resolved), so the anchors are countable targets
+    pools, ibp, pods, topo = make()
+    aff_groups = [
+        tg
+        for tg in topo.topology_groups.values()
+        if str(tg.type) == "pod affinity"
+    ]
+    assert len(aff_groups) == 1
+    assert aff_groups[0].namespaces == frozenset({"team-a", "team-b"})
+
+    # and both solver paths agree end-to-end
+    outs = []
+    for cls in (Scheduler, HybridScheduler):
+        pools, ibp, pods, topo = make()
+        s = cls(pools, ibp, topo)
+        outs.append((s.solve(pods), {p.uid: p.name for p in pods}))
+    (orc, orc_names), (hyb, hyb_names) = outs
+    assert {orc_names[u] for u in orc.pod_errors} == {
+        hyb_names[u] for u in hyb.pod_errors
+    }
