@@ -48,6 +48,7 @@ import numpy as np
 
 from karpenter_tpu.api import labels as well_known
 from karpenter_tpu.controllers.disruption.types import Candidate
+from karpenter_tpu.controllers.state import cluster_source, is_reschedulable
 from karpenter_tpu.solver.oracle import Scheduler, SchedulerOptions
 from karpenter_tpu.solver.topology import Topology
 from karpenter_tpu.solver.tpu import TpuScheduler
@@ -91,8 +92,6 @@ def prefix_feasibility(
         if sn.name in candidate_names:
             continue
         if sn.marked_for_deletion or sn.deleting():
-            from karpenter_tpu.controllers.state import is_reschedulable
-
             if any(is_reschedulable(pd) for pd in cluster.pods_on(sn.name)):
                 raise SweepUnsupported(
                     "reschedulable pods draining off non-candidate nodes"
@@ -122,8 +121,6 @@ def prefix_feasibility(
         pod_prefix.append(-1)  # valid in every prefix
 
     # full-cluster topology (all nodes, all bound pods)
-    from karpenter_tpu.controllers.state import cluster_source
-
     topology = Topology(
         node_pools,
         its_by_pool,
@@ -369,10 +366,21 @@ def bench_sweep(n_nodes: int = 2000, n_candidates: int = 100) -> dict:
     feasible = prefix_feasibility(op.kube, op.cluster, op.cloud, candidates, op.opts)
     sweep_s = time.monotonic() - t0
 
-    # sequential binary search (reference method shape)
+    # sequential binary search (reference method shape), oracle probes
     t0 = time.monotonic()
     cmd_binary = mnc.first_n_binary(candidates)
     binary_s = time.monotonic() - t0
+
+    # binary search with TPU-simulated probes: pow2-bucketed pod AND
+    # existing-slot shapes mean the ~log2(N) probes share a couple of
+    # compiled kernels; warm once, then steady state
+    mnc_tpu = MultiNodeConsolidation(*args, options=op.opts, force_oracle=False)
+    t0 = time.monotonic()
+    mnc_tpu.first_n_binary(candidates)
+    tpu_first_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    cmd_tpu = mnc_tpu.first_n_binary(candidates)
+    tpu_binary_s = time.monotonic() - t0
 
     largest = max((i + 1 for i, f in enumerate(feasible) if f), default=0)
     return {
@@ -381,8 +389,15 @@ def bench_sweep(n_nodes: int = 2000, n_candidates: int = 100) -> dict:
         "sweep_seconds": round(sweep_s, 3),
         "sweep_compile_seconds": round(compile_s, 1),
         "binary_search_seconds": round(binary_s, 3),
+        "tpu_binary_seconds": round(tpu_binary_s, 3),
+        "tpu_binary_compile_seconds": round(max(0.0, tpu_first_s - tpu_binary_s), 1),
         "speedup": round(binary_s / sweep_s, 2) if sweep_s else None,
+        "tpu_binary_speedup": round(binary_s / tpu_binary_s, 2)
+        if tpu_binary_s
+        else None,
         "largest_feasible_prefix": largest,
         "binary_prefix": len(cmd_binary.candidates),
-        "agree": largest == len(cmd_binary.candidates),
+        "tpu_binary_prefix": len(cmd_tpu.candidates),
+        "agree": largest == len(cmd_binary.candidates)
+        and len(cmd_tpu.candidates) == len(cmd_binary.candidates),
     }

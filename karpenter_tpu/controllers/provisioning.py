@@ -308,7 +308,7 @@ class Provisioner:
         for p in pods:
             self.volume_topology.inject(p)  # provisioner.go:286
         views = self.cluster.schedulable_node_views()
-        
+
         topology = Topology(
             node_pools,
             its_by_pool,

@@ -38,6 +38,15 @@ MAX_INSTANCE_TYPES = 600
 _hostname_seq = itertools.count(1)
 
 
+def next_placeholder_hostname() -> str:
+    """The shared synthetic-hostname sequence (nodeclaim.go:92). Every
+    code path that fabricates a claim — the oracle and the TPU decode —
+    MUST draw from this one counter: independent counters collide, merging
+    two claims' topology domain counts (see the hybrid continuation
+    regression in tests/test_hybrid.py)."""
+    return f"hostname-placeholder-{next(_hostname_seq):04d}"
+
+
 @dataclass
 class PodData:
     """Pre-computed pod scheduling data (scheduler.go:186 PodData)."""
@@ -292,7 +301,7 @@ class SchedulingNodeClaim:
         reserved_capacity_enabled: bool = False,
     ):
         self.template = template
-        self.hostname = f"hostname-placeholder-{next(_hostname_seq):04d}"
+        self.hostname = next_placeholder_hostname()
         self.requirements = Requirements(template.requirements.values())
         self.requirements.add(
             Requirement(well_known.HOSTNAME_LABEL_KEY, Operator.IN, [self.hostname])

@@ -522,6 +522,20 @@ class Topology:
         self.topology_groups: dict = {}
         self.inverse_topology_groups: dict = {}
         self.excluded_pods: set[str] = {p.uid for p in pods}
+        # The namespace universe for namespaceSelector resolution: explicit
+        # Namespace objects plus namespaces that exist implicitly because a
+        # pod lives in them (in real Kubernetes the Namespace object always
+        # exists; a sim need not create one). Implicit namespaces carry no
+        # labels, so an empty match-all selector still finds them while a
+        # label-matched selector correctly does not.
+        self._namespace_universe: dict[str, dict[str, str]] = dict(
+            self.cluster.namespace_labels
+        )
+        for ns in self.cluster.pods_by_namespace:
+            self._namespace_universe.setdefault(ns, {})
+        for p in pods:
+            self._namespace_universe.setdefault(p.namespace, {})
+        self._namespace_list_cache: dict = {}
         # label views of real nodes so countDomains can capture domains that
         # exist only on live nodes (topology.go:345-362)
         self.state_node_views = state_node_views or []
@@ -570,13 +584,27 @@ class Topology:
             return frozenset({pod_namespace})
         if selector is None:
             return frozenset(term.namespaces)
-        selected = {
-            name
-            for name, labels in self.cluster.namespace_labels.items()
-            if selector.matches(labels)
-        }
-        selected.update(term.namespaces)
-        return frozenset(selected)
+        # memoized per (selector, explicit list): identical replicas of one
+        # deployment would otherwise rescan the namespace universe N times
+        key = (
+            tuple(sorted(selector.match_labels.items())),
+            tuple(
+                (e.key, e.operator, tuple(e.values))
+                for e in selector.match_expressions
+            ),
+            tuple(sorted(term.namespaces)),
+        )
+        got = self._namespace_list_cache.get(key)
+        if got is None:
+            selected = {
+                name
+                for name, labels in self._namespace_universe.items()
+                if selector.matches(labels)
+            }
+            selected.update(term.namespaces)
+            got = frozenset(selected)
+            self._namespace_list_cache[key] = got
+        return got
 
     def _new_for_topologies(self, pod: Pod) -> list[TopologyGroup]:
         groups = []

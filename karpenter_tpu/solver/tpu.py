@@ -24,7 +24,6 @@ equivalent to the reference's requeue-at-end + stall detection.
 from __future__ import annotations
 
 import collections
-import itertools
 import time as time_mod
 from typing import Optional
 
@@ -36,6 +35,7 @@ from karpenter_tpu.cloudprovider.types import InstanceTypes
 from karpenter_tpu.ops.encode import Reqs, decode_row
 from karpenter_tpu.ops.kernels import VocabArrays
 from karpenter_tpu.scheduling import Requirement, Requirements
+from karpenter_tpu.solver import nodes as nodes_mod
 from karpenter_tpu.solver.nodes import (
     SchedulingNodeClaim,
     StateNodeView,
@@ -46,11 +46,10 @@ from karpenter_tpu.solver.topology import Topology
 from karpenter_tpu.solver.tpu_problem import (
     EncodedProblem,
     UnsupportedBySolver,
+    _pow2,
     encode_problem,
 )
 from karpenter_tpu.utils import resources as res
-
-_claim_seq = itertools.count(1)
 
 
 def _typeok_chunk_impl(ireq, va, preq_chunk, iw: int):
@@ -84,13 +83,6 @@ def _typeok_chunk(ireq, va, preq_chunk, iw: int):
             _typeok_chunk_impl, static_argnames=("iw",)
         )
     return _typeok_chunk_cached(ireq, va, preq_chunk, iw=iw)
-
-
-def _pow2(n: int, floor: int = 8) -> int:
-    p = floor
-    while p < n:
-        p *= 2
-    return p
 
 
 _gather_xs_cached = None
@@ -605,7 +597,7 @@ class TpuScheduler:
             nct = scheduler.templates[int(tmpl[slot])]
             claim = SchedulingNodeClaim.__new__(SchedulingNodeClaim)
             claim.template = nct
-            claim.hostname = f"hostname-placeholder-{next(_claim_seq):04d}"
+            claim.hostname = nodes_mod.next_placeholder_hostname()
             claim.requirements = decode_cached(slot)
             # claims of a class/template share surviving-type sets; build
             # each distinct list once and copy (lists are replaced, never
@@ -662,12 +654,15 @@ class TpuScheduler:
             for vid, val in enumerate(vals):
                 if p.v_reg[g, vid] or v_cnt[g, vid]:
                     tg.domains[val] = int(v_cnt[g, vid])
-        hostnames = [n.view.hostname for n in scheduler.existing_nodes] + [
-            c.hostname for c in claims
-        ]
+        # claim slots sit at offset p.num_existing (the pow2-PADDED count,
+        # not the real node count — padded columns in between are inert)
+        hostnames = [
+            (slot, n.view.hostname)
+            for slot, n in enumerate(scheduler.existing_nodes)
+        ] + [(p.num_existing + j, c.hostname) for j, c in enumerate(claims)]
         for g, hg in enumerate(p.hgroups):
             tg = hg.group
-            for slot, hn in enumerate(hostnames):
+            for slot, hn in hostnames:
                 c = int(h_cnt[g, slot])
                 if c:
                     tg.domains[hn] = c

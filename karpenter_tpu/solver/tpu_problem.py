@@ -153,6 +153,13 @@ class EncodedProblem:
     pown_h: Optional[np.ndarray] = None  # [P, Gh] bool owner (inverse record)
 
 
+def _pow2(n: int, floor: int = 8) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
 def _gate(cond: bool, why: str) -> None:
     if cond:
         raise UnsupportedBySolver(why)
@@ -368,6 +375,23 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
         )
     except UnsupportedProblem as e:
         raise UnsupportedBySolver(str(e)) from e
+
+    # Pad existing-node slots to a pow2 bucket so compiled kernel shapes
+    # (and the XLA compile cache) survive cluster growth: a live control
+    # plane's node count changes every tick, and exact-E shapes would
+    # recompile per solve. Padded slots are inert — eavail=-1 fails every
+    # fits check (tpu_kernel cand_e / tpu_runs _pod_units) and
+    # encode_pod_classes leaves their toleration rows False.
+    E_pad = _pow2(E) if E else 0
+    if E_pad > E:
+        pad_reqs = empty_reqs(vocab, (E_pad - E,))
+        p.ereq = Reqs(
+            *(np.concatenate([a, b]) for a, b in zip(p.ereq, pad_reqs))
+        )
+        p.eavail = np.concatenate(
+            [p.eavail, np.full((E_pad - E, R), -1, np.int32)]
+        )
+        p.num_existing = E_pad
 
     # ---- topology groups ----------------------------------------------
     filter_sets: list[Requirements] = []

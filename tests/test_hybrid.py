@@ -142,3 +142,105 @@ def test_mixed_batch_partitions_per_pod():
     placed = {p.name for c in r.new_node_claims for p in c.pods}
     assert "anyway" in placed
     assert len(placed) == len(pods)
+
+
+def test_continuation_sees_claim_hostname_counts_with_padded_existing_slots():
+    """Regression: existing-node slots are pow2-padded (tpu_problem.py), so
+    claim slots live at offset num_existing (the PADDED count). The decode
+    sync must read each claim's hostname counts from the padded offset —
+    reading from len(existing_nodes) lands on inert padded columns and
+    silently drops every claim's counts, letting an oracle-continuation pod
+    violate hostname anti-affinity the kernel already recorded. (The hybrid
+    partition may legally differ from a pure-oracle run — unsupported pods
+    interleave differently in FFD order — so the contract asserted here is
+    VALIDITY of the combined placement, not partition equality.)"""
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+    from karpenter_tpu.solver.nodes import StateNodeView
+
+    HOSTNAME = well_known.HOSTNAME_LABEL_KEY
+
+    fixtures.reset_rng(11)
+    its = _universe()
+    pool = fixtures.node_pool(name="default")
+    views = [
+        StateNodeView(
+            name=f"existing-{i}",
+            labels={
+                well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a",
+                HOSTNAME: f"existing-{i}",
+                well_known.INSTANCE_TYPE_LABEL_KEY: "c-2x-amd64-linux",
+                well_known.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+                well_known.OS_LABEL_KEY: "linux",
+                well_known.ARCH_LABEL_KEY: "amd64",
+                well_known.NODEPOOL_LABEL_KEY: "default",
+            },
+            available={"cpu": 1500, "memory": 3 * 1024**3 * 1000, "pods": 20_000},
+            capacity={"cpu": 2000, "memory": 4 * 1024**3 * 1000},
+            initialized=True,
+        )
+        for i in range(2)  # 2 real nodes -> padded to 8 slots
+    ]
+    anti = [
+        PodAffinityTerm(
+            topology_key=HOSTNAME,
+            label_selector=LabelSelector(match_labels={"app": "redis"}),
+        )
+    ]
+    pods = [
+        fixtures.pod(
+            name=f"redis-{i}",
+            labels={"app": "redis"},
+            requests={"cpu": "100m"},
+            pod_anti_requirements=[t for t in anti],
+        )
+        for i in range(3)  # 2 land on existing nodes, 1 opens a claim
+    ]
+    # the continuation pod: host ports force the oracle path; its anti
+    # term must SEE the kernel-recorded redis pod on the new claim
+    chaser = fixtures.pod(
+        name="chaser",
+        labels={"app": "web"},
+        requests={"cpu": "100m"},
+        pod_anti_requirements=[t for t in anti],
+    )
+    chaser.host_ports = [("", "TCP", 9090)]
+    pods.append(chaser)
+    topo = Topology([pool], {"default": its}, pods, state_node_views=views)
+    h = HybridScheduler([pool], {"default": its}, topo, views)
+    r = h.solve(pods)
+    assert h.used_tpu is True, h.fallback_reason
+    assert not r.pod_errors, r.pod_errors
+
+    # validity: no hostname holds both the chaser and a redis pod, and the
+    # redis pods are all on distinct hostnames
+    groups = [
+        {p.name for p in c.pods} for c in r.new_node_claims if c.pods
+    ] + [{p.name for p in n.pods} for n in r.existing_nodes if n.pods]
+    for g in groups:
+        redis = {n for n in g if n.startswith("redis")}
+        assert len(redis) <= 1, groups
+        if redis:
+            assert "chaser" not in g, groups
+
+    # and the synced Topology must carry every claim's hostname counts:
+    # the anti group (inverse, counting app=redis pods per hostname) must
+    # show exactly 1 for each hostname holding a redis pod — including the
+    # new claims, whose slots sit beyond the pow2 padding
+    redis_hosts = {}
+    for c in r.new_node_claims:
+        if any(p.name.startswith("redis") for p in c.pods):
+            redis_hosts[c.hostname] = sum(
+                1 for p in c.pods if p.name.startswith("redis")
+            )
+    assert redis_hosts, "expected at least one redis pod on a new claim"
+    hostname_groups = [
+        tg
+        for tg in list(topo.topology_groups.values())
+        + list(topo.inverse_topology_groups.values())
+        if tg.key == HOSTNAME
+    ]
+    assert hostname_groups
+    for hn, want_count in redis_hosts.items():
+        assert any(
+            tg.domains.get(hn) == want_count for tg in hostname_groups
+        ), (hn, want_count, [dict(tg.domains) for tg in hostname_groups])

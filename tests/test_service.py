@@ -213,3 +213,84 @@ def test_native_client_ping_and_solve(server, tmp_path):
     name_of = {p.uid: p.name for p in pods}
     local_names = {p.name for n in r.existing_nodes for p in n.pods}
     assert {name_of[u] for u in decoded["existing_assignments"]} == local_names
+
+
+def test_namespace_labels_ride_the_wire(server):
+    """namespaceSelector terms must resolve identically over the service
+    boundary: the namespace->labels map is part of the problem request
+    (service.py encode/_decode_problem_request) and feeds the server-side
+    ClusterSource. Without it the selector matches nothing and the
+    cross-namespace affinity below degrades to an error."""
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+
+    def make_pods():
+        fixtures.reset_rng(23)
+        anchor = fixtures.pod(
+            name="anchor", labels={"db": "primary"}, requests={"cpu": "100m"}
+        )
+        anchor.metadata.namespace = "team-a"
+        followers = []
+        for i in range(3):
+            p = fixtures.pod(
+                name=f"follow-{i}",
+                labels={"app": "web"},
+                requests={"cpu": "100m"},
+                pod_requirements=[
+                    PodAffinityTerm(
+                        topology_key=well_known.HOSTNAME_LABEL_KEY,
+                        label_selector=LabelSelector(match_labels={"db": "primary"}),
+                        namespace_selector=LabelSelector(
+                            match_labels={"tier": "backend"}
+                        ),
+                    )
+                ],
+            )
+            p.metadata.namespace = "frontend"
+            followers.append(p)
+        return [anchor] + followers
+
+    ns_labels = {
+        "team-a": {"tier": "backend"},
+        "frontend": {"tier": "frontend"},
+        "default": {},
+    }
+    fixtures.reset_rng(23)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    pods = make_pods()
+
+    c = SolverClient(server.socket_path)
+    c.connect(timeout=120.0)
+    got = c.solve(
+        pools, {"default": its}, pods,
+        force_oracle=True, namespace_labels=ns_labels,
+    )
+    c.close()
+    assert not got["pod_errors"], got["pod_errors"]
+
+    # matches the in-process solve with the same ClusterSource
+    from karpenter_tpu.solver.topology import ClusterSource
+
+    pods2 = make_pods()
+    topo = Topology(
+        pools, {"default": its}, pods2,
+        cluster=ClusterSource(namespace_labels=ns_labels),
+    )
+    s = HybridScheduler(
+        pools, {"default": its}, topo, None, None, SchedulerOptions(),
+        force_oracle=True,
+    )
+    r = s.solve(pods2)
+    assert not r.pod_errors
+    name_of = {p.uid: p.name for p in pods}
+    remote_parts = sorted(
+        tuple(sorted(name_of[u] for u in cl["pod_uids"]))
+        for cl in got["new_node_claims"]
+        if cl["pod_uids"]
+    )
+    local_parts = sorted(
+        tuple(sorted(p.name for p in cl.pods))
+        for cl in r.new_node_claims
+        if cl.pods
+    )
+    assert remote_parts == local_parts

@@ -836,3 +836,50 @@ def test_affinity_namespace_selector_unions_namespaces():
     assert {orc_names[u] for u in orc.pod_errors} == {
         hyb_names[u] for u in hyb.pod_errors
     }
+
+
+def test_affinity_empty_namespace_selector_matches_implicit_namespaces():
+    """An empty namespaceSelector (LabelSelector()) matches ALL namespaces —
+    including ones that exist only implicitly because a pod lives there (in
+    real Kubernetes the Namespace object always exists; the sim need not
+    create one). The anchor below lives in 'team-x' with no Namespace
+    object anywhere; the follower's match-all selector must still resolve
+    it (topology.go:503 buildNamespaceList)."""
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+
+    def pods():
+        anchor = fixtures.pod(
+            name="anchor", labels={"db": "primary"}, requests={"cpu": "100m"}
+        )
+        anchor.metadata.namespace = "team-x"
+        out = [anchor]
+        for i in range(3):
+            p = fixtures.pod(
+                name=f"follow-{i}",
+                labels={"app": "web"},
+                requests={"cpu": "100m"},
+                pod_requirements=[
+                    PodAffinityTerm(
+                        topology_key=HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"db": "primary"}),
+                        namespace_selector=LabelSelector(),  # match-all
+                    )
+                ],
+            )
+            p.metadata.namespace = "frontend"
+            out.append(p)
+        return out
+
+    r = run_parity(problem(pods))
+    assert not r.pod_errors
+    # group resolution: the followers' group must span the anchor's
+    # implicit namespace
+    fixtures.reset_rng(42)
+    its = construct_instance_types(sizes=[2, 8])
+    pool = fixtures.node_pool(name="default")
+    topo = Topology([pool], {"default": its}, pods())
+    aff = [
+        tg for tg in topo.topology_groups.values() if str(tg.type) == "pod affinity"
+    ]
+    assert len(aff) == 1
+    assert "team-x" in aff[0].namespaces
