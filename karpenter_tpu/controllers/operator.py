@@ -149,7 +149,10 @@ class Operator:
             from karpenter_tpu.controllers.probes import ProbeServer
 
             self.probes = ProbeServer(
-                self.kube, self.cluster, port=self.opts.probe_port
+                self.kube,
+                self.cluster,
+                port=self.opts.probe_port,
+                enable_profiling=self.opts.enable_profiling,
             )
             self.probes.start()
         self.node_metrics = NodeMetricsController(self.cluster)

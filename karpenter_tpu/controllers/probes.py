@@ -10,6 +10,14 @@ thread when `Options.probe_port` is set (port 0 picks a free one):
 - /readyz   — readiness: the cluster-state cache is synced with the store
   (the same barrier every controller takes before acting, cluster.go:118).
 - /metrics  — the Prometheus-style exposition of karpenter_tpu.metrics.
+
+When constructed with enable_profiling=True (operator.go:183 --enable-
+profiling gate) it additionally serves the pprof analogs from
+karpenter_tpu.profiling:
+
+- /debug/pprof/profile?seconds=N — sampling CPU profile of every live
+  thread, collapsed-stack format (add &top=1 for a pprof-top table).
+- /debug/pprof/heap — tracemalloc top allocation sites.
 """
 
 from __future__ import annotations
@@ -22,9 +30,17 @@ from karpenter_tpu import metrics
 
 
 class ProbeServer:
-    def __init__(self, kube, cluster, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(
+        self,
+        kube,
+        cluster,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        enable_profiling: bool = False,
+    ):
         self.kube = kube
         self.cluster = cluster
+        self.enable_profiling = enable_profiling
         self._host = host
         self._port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -36,6 +52,7 @@ class ProbeServer:
 
     def start(self) -> None:
         kube, cluster = self.kube, self.cluster
+        profiling_on = self.enable_profiling
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # quiet
@@ -65,6 +82,33 @@ class ProbeServer:
                         self._reply(503, f"metrics unavailable: {e}")
                         return
                     self._reply(200, body, ctype="text/plain; version=0.0.4")
+                elif self.path.startswith("/debug/pprof/") and profiling_on:
+                    from urllib.parse import parse_qs, urlparse
+
+                    from karpenter_tpu import profiling
+
+                    url = urlparse(self.path)
+                    q = parse_qs(url.query)
+                    if url.path == "/debug/pprof/profile":
+                        try:
+                            seconds = float(q.get("seconds", ["1"])[0])
+                        except ValueError:
+                            self._reply(400, "seconds must be a number")
+                            return
+                        if not (seconds > 0):  # also rejects NaN
+                            self._reply(400, "seconds must be positive")
+                            return
+                        sampler = profiling.profile_cpu(min(seconds, 60.0))
+                        body = (
+                            sampler.render_top()
+                            if q.get("top", ["0"])[0] == "1"
+                            else sampler.render_collapsed()
+                        )
+                        self._reply(200, body)
+                    elif url.path == "/debug/pprof/heap":
+                        self._reply(200, profiling.heap_snapshot())
+                    else:
+                        self._reply(404, "unknown pprof endpoint")
                 else:
                     self._reply(404, "not found")
 

@@ -586,14 +586,9 @@ class Topology:
             return frozenset(term.namespaces)
         # memoized per (selector, explicit list): identical replicas of one
         # deployment would otherwise rescan the namespace universe N times
-        key = (
-            tuple(sorted(selector.match_labels.items())),
-            tuple(
-                (e.key, e.operator, tuple(e.values))
-                for e in selector.match_expressions
-            ),
-            tuple(sorted(term.namespaces)),
-        )
+        from karpenter_tpu.solver.ordering import _selector_key
+
+        key = (_selector_key(selector), tuple(sorted(term.namespaces)))
         got = self._namespace_list_cache.get(key)
         if got is None:
             selected = {
