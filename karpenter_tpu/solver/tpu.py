@@ -24,6 +24,7 @@ equivalent to the reference's requeue-at-end + stall detection.
 from __future__ import annotations
 
 import collections
+import os
 import time as time_mod
 from typing import Optional
 
@@ -98,20 +99,29 @@ def _gather_xs(tables, idx, valid):
         def impl(tables, idx, valid):
             from karpenter_tpu.solver import tpu_kernel as K
 
+            # Heavy rows live per REQUIREMENT-class (pod_class_key without
+            # the request vector — few distinct values even when every pod's
+            # requests differ); only the request vectors are per
+            # encode-class. This keeps the per-solve host->device upload
+            # proportional to distinct requirement shapes, not pods — the
+            # tunnel transfer of per-pod requirement rows used to dominate
+            # solve wall-clock.
             (
-                preq_c, prequests_c, typeok_c, tol_t_c, tol_e_c,
-                kind_c, gid_c, tsel_c, cls, sel_v, sel_h, inv_h, own_h,
+                preq_r, typeok_r, tol_t_r, tol_e_r,
+                kind_r, gid_r, tsel_r, rcls_of,
+                prequests_c, cls, sel_v, sel_h, inv_h, own_h,
             ) = tables
             ci = cls[idx]
+            ri = rcls_of[ci]
             return K.PodX(
-                preq=Reqs(*(a[ci] for a in preq_c)),
+                preq=Reqs(*(a[ri] for a in preq_r)),
                 prequests=prequests_c[ci],
-                typeok=typeok_c[ci],
-                tol_t=tol_t_c[ci],
-                tol_e=tol_e_c[ci],
-                topo_kind=kind_c[ci],
-                topo_gid=gid_c[ci],
-                topo_sel=tsel_c[ci],
+                typeok=typeok_r[ri],
+                tol_t=tol_t_r[ri],
+                tol_e=tol_e_r[ri],
+                topo_kind=kind_r[ri],
+                topo_gid=gid_r[ri],
+                topo_sel=tsel_r[ri],
                 sel_v=sel_v[idx],
                 sel_h=sel_h[idx],
                 inv_h=inv_h[idx],
@@ -121,6 +131,33 @@ def _gather_xs(tables, idx, valid):
 
         _gather_xs_cached = jax.jit(impl)
     return _gather_xs_cached(tables, idx, valid)
+
+
+_slice_decode_cached = None
+
+
+def _slice_decode_state(st, n2: int, ecols: int):
+    """Device-side slice of the decode-relevant State fields to the live
+    pow2 claim bucket (module-level jit cache; n2/ecols are static so each
+    bucket compiles once)."""
+    global _slice_decode_cached
+    if _slice_decode_cached is None:
+        import jax
+
+        def impl(st, n2, ecols):
+            return (
+                Reqs(*(a[:n2] for a in st.creq)),
+                st.crequests[:n2],
+                st.alive[:n2],
+                st.tmpl[:n2],
+                st.eavail,
+                st.ereq,
+                st.v_cnt,
+                st.h_cnt[:, :ecols],
+            )
+
+        _slice_decode_cached = jax.jit(impl, static_argnames=("n2", "ecols"))
+    return _slice_decode_cached(st, n2=n2, ecols=ecols)
 
 
 def _popcount_rows(seg: np.ndarray) -> np.ndarray:
@@ -230,12 +267,16 @@ class TpuScheduler:
         back to the oracle."""
         import jax  # deferred so encoding errors surface first
 
+        from karpenter_tpu.profiling import SolveProfile
+
+        prof = self.last_profile = SolveProfile()
         if not pods:
             return Results(
                 new_node_claims=[], existing_nodes=self.oracle.existing_nodes,
                 pod_errors={},
             )
-        problem = encode_problem(self.oracle, pods)
+        with prof.phase("encode"):
+            problem = encode_problem(self.oracle, pods)
         deadline = (
             time_mod.monotonic() + self.opts.timeout_seconds
             if self.opts.timeout_seconds
@@ -247,20 +288,22 @@ class TpuScheduler:
         # identical pods contiguous for the run kernel
         from karpenter_tpu.solver.ordering import ffd_sort_key
 
-        data = self.oracle.cached_pod_data
-        for p in pods:
-            self.oracle._update_cached_pod_data(p)
-        order = sorted(
-            range(len(pods)),
-            key=lambda i: ffd_sort_key(pods[i], data[pods[i].uid].requests),
-        )
+        with prof.phase("order"):
+            data = self.oracle.cached_pod_data
+            for p in pods:
+                self.oracle._update_cached_pod_data(p)
+            order = sorted(
+                range(len(pods)),
+                key=lambda i: ffd_sort_key(pods[i], data[pods[i].uid].requests),
+            )
 
         from karpenter_tpu.solver import tpu_kernel as K
         from karpenter_tpu.solver import tpu_runs as KR
 
-        tb = self._tables(problem)
-        self._typeok = self._pod_typeok(problem, tb)
-        self._upload_pod_tables(problem)
+        with prof.phase("upload"):
+            tb = self._tables(problem)
+            self._typeok = self._pod_typeok(problem, tb)
+            self._upload_pod_tables(problem)
         gates_ok = _bulk_gates(problem)
         self._bulk_flags = _bulk_pod_flags(problem, gates_ok)
         use_runs = bool(self._bulk_flags.any())
@@ -270,7 +313,11 @@ class TpuScheduler:
         # bench mix averages ~5 pods/claim), so start small and grow on the
         # kernel's overflow signal — smaller N cuts every per-step candidate
         # screen. Worst case (one pod per claim) ends at _pow2(len(pods)).
-        N = min(_pow2(max(64, (len(pods) + 3) // 4)), _pow2(len(pods)))
+        try:
+            div = max(1, int(os.environ.get("KARPENTER_TPU_CLAIM_SLOT_DIV", "4")))
+        except ValueError:
+            div = 4
+        N = min(_pow2(max(64, (len(pods) + div - 1) // div)), _pow2(len(pods)))
         while True:
             st = self._init_state(problem, N)
             seq = jax.numpy.zeros(N, jax.numpy.int32)
@@ -285,22 +332,27 @@ class TpuScheduler:
                     timed_out = True
                     break
                 if use_runs:
-                    xs = self._pod_xs(problem, pending)
-                    rx = self._run_x(problem, pending, xs)
-                    st, seq, next_seq, got_kinds, got_slots, got_over, iters = (
-                        KR.solve_runs(
-                            tb, st, rx, seq, next_seq,
-                            jax.numpy.int32(len(pending)),
+                    with prof.phase("pod_xs"):
+                        xs = self._pod_xs(problem, pending)
+                        rx = self._run_x(problem, pending, xs)
+                    with prof.phase("kernel"):
+                        st, seq, next_seq, got_kinds, got_slots, got_over, iters = (
+                            KR.solve_runs(
+                                tb, st, rx, seq, next_seq,
+                                jax.numpy.int32(len(pending)),
+                            )
                         )
-                    )
                     self.last_iters = iters
                 else:
-                    xs = self._pod_xs(problem, pending)
-                    st, got_kinds, got_slots, got_over = K.solve_scan(tb, st, xs)
+                    with prof.phase("pod_xs"):
+                        xs = self._pod_xs(problem, pending)
+                    with prof.phase("kernel"):
+                        st, got_kinds, got_slots, got_over = K.solve_scan(tb, st, xs)
                 # one batched device->host fetch (the tunnel charges per call)
-                got_kinds, got_slots, got_over = jax.device_get(
-                    (got_kinds, got_slots, got_over)
-                )
+                with prof.phase("fetch"):
+                    got_kinds, got_slots, got_over = jax.device_get(
+                        (got_kinds, got_slots, got_over)
+                    )
                 if bool(got_over):
                     overflowed = True
                     break
@@ -316,7 +368,8 @@ class TpuScheduler:
                 break
             N *= 2  # slots exhausted: re-solve from scratch with room
 
-        return self._decode(problem, st, kinds, slots, timed_out)
+        with prof.phase("decode"):
+            return self._decode(problem, st, kinds, slots, timed_out)
 
     def _run_x(self, p: EncodedProblem, indices: list[int], xs):
         """Build the run-kernel driver arrays for a pending subsequence."""
@@ -354,31 +407,63 @@ class TpuScheduler:
             run_rem=jnp.asarray(run_rem),
         )
 
-    def _pod_typeok(self, p: EncodedProblem, tb) -> np.ndarray:
-        """[P, IW] u32 — per pod, the instance types whose requirements
-        intersect the pod's (pairwise screen; the kernel's while_loop stays
-        exact for three-way intersections, offerings, and minValues).
-        Computed per encode-class (pods of a class share rows) and gathered
-        host-side — the device tunnel charges per byte."""
-        import jax.numpy as jnp
+    def _rclass_map(self, p: EncodedProblem):
+        """(rcls_of [NC] i32, rreps list of pod indices) — requirement-class
+        structure over the encode classes. Two encode classes share a
+        requirement class when their pods' pod_class_key (everything but
+        the request vector) is equal; every device table except prequests
+        depends only on the requirement class."""
+        if getattr(self, "_rmap_for", None) is p:
+            return self._rmap
 
-        I = p.num_types
-        IW = max(1, (I + 31) // 32)
+        from karpenter_tpu.solver.ordering import pod_class_key
+
         cls = p.pod_class
         NC = int(cls.max()) + 1 if len(cls) else 0
         reps = np.zeros(NC, dtype=np.int64)
         reps[cls[::-1]] = np.arange(len(cls) - 1, -1, -1)
-        out_c = np.zeros((NC, IW), dtype=np.uint32)
+        rkey_to_id: dict = {}
+        rcls_of = np.zeros(NC, dtype=np.int32)
+        rreps: list[int] = []
+        for c in range(NC):
+            i = int(reps[c])
+            k = pod_class_key(p.pods[i])
+            rid = rkey_to_id.get(k)
+            if rid is None:
+                rid = len(rreps)
+                rkey_to_id[k] = rid
+                rreps.append(i)
+            rcls_of[c] = rid
+        self._rmap = (rcls_of, rreps, reps)
+        self._rmap_for = p
+        return self._rmap
+
+    def _pod_typeok(self, p: EncodedProblem, tb):
+        """[NR, IW] u32 DEVICE array — per requirement-class, the instance
+        types whose requirements intersect the class's (pairwise screen;
+        the kernel's while_loop stays exact for three-way intersections,
+        offerings, and minValues). Stays on device end-to-end: the profile
+        showed pulling it to host only to re-upload in _upload_pod_tables
+        cost ~0.5s/solve in tunnel round-trips."""
+        import jax.numpy as jnp
+
+        I = p.num_types
+        IW = max(1, (I + 31) // 32)
+        _, rreps, _ = self._rclass_map(p)
+        NR = len(rreps)
+        rr = np.asarray(rreps, dtype=np.int64)
+        chunks = []
         CH = 2048
-        for lo in range(0, NC, CH):
-            hi = min(lo + CH, NC)
+        for lo in range(0, NR, CH):
+            hi = min(lo + CH, NR)
             # pow2-pad chunks so compiled shapes are reused across solves
             pad_to = min(CH, _pow2(hi - lo))
-            idx = reps[np.arange(lo, lo + pad_to) % NC]
+            idx = rr[np.arange(lo, lo + pad_to) % NR]
             chunk = Reqs(*(jnp.asarray(a[idx]) for a in p.preq))
-            got = np.asarray(_typeok_chunk(tb.ireq, tb.va, chunk, iw=IW))
-            out_c[lo:hi] = got[: hi - lo]
-        return out_c[cls]
+            chunks.append(_typeok_chunk(tb.ireq, tb.va, chunk, iw=IW)[: hi - lo])
+        if not chunks:
+            return jnp.zeros((0, IW), jnp.uint32)
+        return chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
 
     # -- tensor construction --------------------------------------------
 
@@ -479,15 +564,17 @@ class TpuScheduler:
         )
 
     def _upload_pod_tables(self, p: EncodedProblem) -> None:
-        """Ship per-CLASS tables plus per-pod selection rows to the device
-        once per solve; per-round pod batches are then just an index array
-        (the device tunnel charges per byte)."""
+        """Ship pod tables to the device once per solve; per-round pod
+        batches are then just an index array (the tunnel charges per byte).
+        Heavy rows (requirements, type screens, tolerations, topology
+        ownership) upload per REQUIREMENT-class; only the request vectors
+        upload per encode-class, so a 10k-pod mix with 10k distinct request
+        vectors but a handful of requirement shapes ships KBs, not MBs."""
         import jax.numpy as jnp
 
         cls = p.pod_class
-        NC = int(cls.max()) + 1 if len(cls) else 1
-        reps = np.zeros(NC, dtype=np.int64)
-        reps[cls[::-1]] = np.arange(len(cls) - 1, -1, -1)
+        rcls_of, rreps, reps = self._rclass_map(p)
+        rr = np.asarray(rreps, dtype=np.int64)
         Gv = max(len(p.vgroups), 1)
         Gh = max(len(p.hgroups), 1)
 
@@ -497,14 +584,16 @@ class TpuScheduler:
             return np.zeros((a.shape[0], G), a.dtype)
 
         self._dev_tables = (
-            Reqs(*(jnp.asarray(a[reps]) for a in p.preq)),
+            Reqs(*(jnp.asarray(a[rr]) for a in p.preq)),
+            # _pod_typeok is already per requirement-class on device
+            self._typeok,
+            jnp.asarray(p.ptol_t[rr]),
+            jnp.asarray(p.ptol_e[rr]),
+            jnp.asarray(p.ptopo_kind[rr]),
+            jnp.asarray(p.ptopo_gid[rr]),
+            jnp.asarray(p.ptopo_sel[rr]),
+            jnp.asarray(rcls_of),
             jnp.asarray(p.prequests[reps]),
-            jnp.asarray(self._typeok[reps]),
-            jnp.asarray(p.ptol_t[reps]),
-            jnp.asarray(p.ptol_e[reps]),
-            jnp.asarray(p.ptopo_kind[reps]),
-            jnp.asarray(p.ptopo_gid[reps]),
-            jnp.asarray(p.ptopo_sel[reps]),
             jnp.asarray(cls.astype(np.int32)),
             jnp.asarray(pad_g(p.psel_v, Gv)),
             jnp.asarray(pad_g(p.psel_h, Gh)),
@@ -540,16 +629,18 @@ class TpuScheduler:
 
         vocab, table = p.vocab, p.table
         scheduler = self.oracle
-        # one batched device->host fetch of ONLY the fields decode reads
-        # (the tunnel charges per byte; count/rank/topology stay behind)
+        # Two-phase fetch: the scalar claim count first, then ONLY the live
+        # claim rows (pow2-bucketed so the slice jit caches) — most solves
+        # fill a fraction of the N padded slots, and the tunnel charges per
+        # byte. count/rank/topology stay behind entirely.
+        n_claims = int(jax.device_get(st.n_claims))
+        N = st.active.shape[0]
+        n2 = min(_pow2(max(n_claims, 1), floor=64), N)
+        E = st.eavail.shape[0]
         st = jax.device_get(
-            (
-                st.n_claims, st.creq, st.crequests, st.alive, st.tmpl,
-                st.eavail, st.ereq, st.v_cnt, st.h_cnt,
-            )
+            _slice_decode_state(st, n2=n2, ecols=E + n2)
         )
-        st = _DecodeView(*st)
-        n_claims = int(st.n_claims)
+        st = _DecodeView(np.int32(n_claims), *st)
         creq = Reqs(*(np.asarray(a) for a in st.creq))
         crequests = np.asarray(st.crequests)
         alive = np.asarray(st.alive)
