@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import copy
 import itertools
+import threading
 import time as time_mod
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Optional
@@ -88,6 +89,13 @@ class SimKube:
         self._version = itertools.count(1)
         self._subscribers: list[Subscriber] = []
         self.clock = clock if clock is not None else RealClock()
+        # Each CRUD op (including its synchronous watch emit) is atomic
+        # under this lock, so controller reconciles may run on a worker
+        # pool (utils/workerpool.py) the way the reference scales its
+        # reconcilers (termination/controller.go:58-60). Cross-op races
+        # surface as Conflict — the same optimistic-concurrency contract
+        # the real apiserver gives controller-runtime.
+        self._lock = threading.RLock()
 
     # -- watch ------------------------------------------------------------
 
@@ -111,83 +119,90 @@ class SimKube:
     # -- CRUD -------------------------------------------------------------
 
     def create(self, kind: str, obj):
-        store = self._store(kind)
-        name = self._name(obj)
-        if name in store:
-            raise AlreadyExists(f"{kind}/{name}")
-        obj = copy.deepcopy(obj)
-        if getattr(obj, "metadata", None) is not None:
-            obj.metadata.resource_version = next(self._version)
-        store[name] = obj
-        self._emit(ADDED, kind, copy.deepcopy(obj))
-        return copy.deepcopy(obj)
+        with self._lock:
+            store = self._store(kind)
+            name = self._name(obj)
+            if name in store:
+                raise AlreadyExists(f"{kind}/{name}")
+            obj = copy.deepcopy(obj)
+            if getattr(obj, "metadata", None) is not None:
+                obj.metadata.resource_version = next(self._version)
+            store[name] = obj
+            self._emit(ADDED, kind, copy.deepcopy(obj))
+            return copy.deepcopy(obj)
 
     def get(self, kind: str, name: str):
-        obj = self._store(kind).get(name)
-        if obj is None:
-            raise NotFound(f"{kind}/{name}")
-        return copy.deepcopy(obj)
+        with self._lock:
+            obj = self._store(kind).get(name)
+            if obj is None:
+                raise NotFound(f"{kind}/{name}")
+            return copy.deepcopy(obj)
 
     def try_get(self, kind: str, name: str):
-        obj = self._store(kind).get(name)
-        return copy.deepcopy(obj) if obj is not None else None
+        with self._lock:
+            obj = self._store(kind).get(name)
+            return copy.deepcopy(obj) if obj is not None else None
 
     def list(self, kind: str, filter: Optional[Callable[[object], bool]] = None):
-        out = [copy.deepcopy(o) for o in self._store(kind).values()]
-        if filter is not None:
-            out = [o for o in out if filter(o)]
-        return out
+        with self._lock:
+            out = [copy.deepcopy(o) for o in self._store(kind).values()]
+            if filter is not None:
+                out = [o for o in out if filter(o)]
+            return out
 
     def update(self, kind: str, obj):
         """Optimistic-concurrency update; finalizer-clearing completes a
         pending delete."""
-        store = self._store(kind)
-        name = self._name(obj)
-        current = store.get(name)
-        if current is None:
-            raise NotFound(f"{kind}/{name}")
-        if obj.metadata.resource_version != current.metadata.resource_version:
-            raise Conflict(
-                f"{kind}/{name}: version {obj.metadata.resource_version} != "
-                f"{current.metadata.resource_version}"
-            )
-        obj = copy.deepcopy(obj)
-        obj.metadata.resource_version = next(self._version)
-        if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
-            del store[name]
-            self._emit(DELETED, kind, copy.deepcopy(obj))
-            return None
-        store[name] = obj
-        self._emit(UPDATED, kind, copy.deepcopy(obj))
-        return copy.deepcopy(obj)
+        with self._lock:
+            store = self._store(kind)
+            name = self._name(obj)
+            current = store.get(name)
+            if current is None:
+                raise NotFound(f"{kind}/{name}")
+            if obj.metadata.resource_version != current.metadata.resource_version:
+                raise Conflict(
+                    f"{kind}/{name}: version {obj.metadata.resource_version} != "
+                    f"{current.metadata.resource_version}"
+                )
+            obj = copy.deepcopy(obj)
+            obj.metadata.resource_version = next(self._version)
+            if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+                del store[name]
+                self._emit(DELETED, kind, copy.deepcopy(obj))
+                return None
+            store[name] = obj
+            self._emit(UPDATED, kind, copy.deepcopy(obj))
+            return copy.deepcopy(obj)
 
     def delete(self, kind: str, name: str, now: Optional[float] = None):
-        store = self._store(kind)
-        current = store.get(name)
-        if current is None:
-            raise NotFound(f"{kind}/{name}")
-        if current.metadata.finalizers:
-            if current.metadata.deletion_timestamp is None:
-                current.metadata.deletion_timestamp = (
-                    self.clock.now() if now is None else now
-                )
-                current.metadata.resource_version = next(self._version)
-                self._emit(UPDATED, kind, copy.deepcopy(current))
+        with self._lock:
+            store = self._store(kind)
+            current = store.get(name)
+            if current is None:
+                raise NotFound(f"{kind}/{name}")
+            if current.metadata.finalizers:
+                if current.metadata.deletion_timestamp is None:
+                    current.metadata.deletion_timestamp = (
+                        self.clock.now() if now is None else now
+                    )
+                    current.metadata.resource_version = next(self._version)
+                    self._emit(UPDATED, kind, copy.deepcopy(current))
+                return None
+            del store[name]
+            self._emit(DELETED, kind, copy.deepcopy(current))
             return None
-        del store[name]
-        self._emit(DELETED, kind, copy.deepcopy(current))
-        return None
 
     # -- typed conveniences ----------------------------------------------
 
     def bind(self, pod_name: str, node_name: str) -> None:
         """The kube-scheduler binding equivalent."""
-        pod = self._store("Pod").get(pod_name)
-        if pod is None:
-            raise NotFound(f"Pod/{pod_name}")
-        pod.node_name = node_name
-        pod.metadata.resource_version = next(self._version)
-        self._emit(UPDATED, "Pod", copy.deepcopy(pod))
+        with self._lock:
+            pod = self._store("Pod").get(pod_name)
+            if pod is None:
+                raise NotFound(f"Pod/{pod_name}")
+            pod.node_name = node_name
+            pod.metadata.resource_version = next(self._version)
+            self._emit(UPDATED, "Pod", copy.deepcopy(pod))
 
     def pending_pods(self) -> list[Pod]:
         return self.list(

@@ -94,7 +94,8 @@ class Operator:
             self.kube, self.cluster, self.cloud, self.clock, self.opts, self.recorder
         )
         self.termination = NodeTermination(
-            self.kube, self.cluster, self.cloud, self.clock, self.recorder
+            self.kube, self.cluster, self.cloud, self.clock, self.recorder,
+            workers=self.opts.termination_workers,
         )
         self.disruption = DisruptionController(
             self.kube,
@@ -155,6 +156,18 @@ class Operator:
                 enable_profiling=self.opts.enable_profiling,
             )
             self.probes.start()
+        # leader election (operator.go:157-182): configured via lease_path;
+        # a standby keeps its informers/cache warm but acts on nothing
+        self.elector = None
+        if self.opts.leader_elect_lease_path:
+            from karpenter_tpu.leaderelection import LeaderElector
+
+            self.elector = LeaderElector(
+                self.opts.leader_elect_lease_path,
+                lease_duration=self.opts.leader_elect_lease_seconds,
+                renew_period=self.opts.leader_elect_renew_seconds,
+                clock=self.clock,
+            )
         self.node_metrics = NodeMetricsController(self.cluster)
         self.nodepool_metrics = NodePoolMetricsController(self.kube)
         self.pod_metrics = PodMetricsController(self.kube, self.cluster, self.clock)
@@ -182,6 +195,8 @@ class Operator:
         if self.probes is not None:
             self.probes.stop()
             self.probes = None
+        if self.elector is not None:
+            self.elector.release()  # hand off without waiting out the lease
         from karpenter_tpu import logging as klog
 
         if klog.root._clock is self.clock:
@@ -195,6 +210,8 @@ class Operator:
         the store subscription)."""
         if isinstance(self.clock, FakeClock):
             self.clock.advance(advance_seconds)
+        if self.elector is not None and not self.elector.ensure():
+            return  # standby: informers stay warm via store subscriptions
         if hasattr(self.cloud, "reconcile"):
             self.cloud.reconcile()  # KWOK registration delays
         self.nodepool_hash.reconcile_all()

@@ -328,6 +328,7 @@ class Provisioner:
                 min_values_best_effort=self.opts.min_values_policy == "BestEffort",
                 reserved_capacity_enabled=self.opts.feature_gates.reserved_capacity,
                 timeout_seconds=self.opts.solve_timeout_seconds,
+                claim_slot_div=self.opts.tpu_claim_slot_div,
             ),
             force_oracle=self.force_oracle,
         )

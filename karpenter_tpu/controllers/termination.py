@@ -54,6 +54,7 @@ class NodeTermination:
         cloud_provider,
         clock,
         recorder: Optional[Recorder] = None,
+        workers: int = 1,
     ):
         self.kube = kube
         self.cluster = cluster
@@ -61,11 +62,35 @@ class NodeTermination:
         self.clock = clock
         self.recorder = recorder or Recorder(clock)
         self.log = logging.root.named("node.termination")
+        # reconciler pool width (reference termination/controller.go:58-60
+        # scales 100->5000). Per-node reconciles are independent; SimKube
+        # ops are atomic and cross-reconcile races surface as Conflict,
+        # which reconcile() already treats as requeue-next-tick. PDB
+        # accounting lives OUTSIDE that optimistic concurrency, so
+        # evictions serialize under _evict_lock — the analog of the
+        # reference's single eviction queue (terminator/eviction.go:93),
+        # which exists for exactly this reason.
+        import threading
+
+        self._evict_lock = threading.Lock()
+        self.workers = workers
 
     def reconcile_all(self) -> None:
-        for node in self.kube.list("Node"):
-            if node.metadata.deletion_timestamp is not None:
-                self.reconcile(node.name)
+        from karpenter_tpu.utils.workerpool import parallelize_until
+
+        names = [
+            node.name
+            for node in self.kube.list("Node")
+            if node.metadata.deletion_timestamp is not None
+        ]
+        errs = parallelize_until(
+            self.workers, len(names), lambda i: self.reconcile(names[i])
+        )
+        for name, err in zip(names, errs):
+            if err is not None:
+                self.log.error(
+                    "termination reconcile failed", node=name, error=str(err)
+                )
 
     def reconcile(self, name: str) -> Optional[str]:
         node = self.kube.try_get("Node", name)
@@ -154,7 +179,14 @@ class NodeTermination:
     # -- eviction ---------------------------------------------------------
 
     def _evict(self, pods: list[Pod], force: bool) -> int:
-        """PDB-aware evictions (eviction.go:93). Returns how many started."""
+        """PDB-aware evictions (eviction.go:93). Returns how many started.
+        Snapshot-to-mark is atomic under _evict_lock: two workers evicting
+        different pods under one PDB would otherwise both act on a stale
+        allowed-count and jointly overrun the budget."""
+        with self._evict_lock:
+            return self._evict_locked(pods, force)
+
+    def _evict_locked(self, pods: list[Pod], force: bool) -> int:
         from karpenter_tpu.utils.pdb import PDBLimits
 
         limits = PDBLimits.from_kube(self.kube)

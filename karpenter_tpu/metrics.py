@@ -8,6 +8,8 @@ series vanish when the backing object does). Exposition via render()."""
 
 from __future__ import annotations
 
+import threading
+
 import math
 import time
 from contextlib import contextmanager
@@ -35,10 +37,14 @@ class Counter(Metric):
     def __init__(self, name, help, label_names=()):
         super().__init__(name, help, tuple(label_names))
         self.values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
 
     def inc(self, labels: Optional[dict] = None, by: float = 1.0) -> None:
         k = self._key(labels or {})
-        self.values[k] = self.values.get(k, 0.0) + by
+        # controllers may run on worker pools (utils/workerpool.py); the
+        # read-modify-write must not lose increments under preemption
+        with self._lock:
+            self.values[k] = self.values.get(k, 0.0) + by
 
     def value(self, labels: Optional[dict] = None) -> float:
         return self.values.get(self._key(labels or {}), 0.0)
@@ -48,13 +54,15 @@ class Gauge(Metric):
     def __init__(self, name, help, label_names=()):
         super().__init__(name, help, tuple(label_names))
         self.values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
 
     def set(self, value: float, labels: Optional[dict] = None) -> None:
         self.values[self._key(labels or {})] = value
 
     def add(self, by: float, labels: Optional[dict] = None) -> None:
         k = self._key(labels or {})
-        self.values[k] = self.values.get(k, 0.0) + by
+        with self._lock:
+            self.values[k] = self.values.get(k, 0.0) + by
 
     def value(self, labels: Optional[dict] = None) -> float:
         return self.values.get(self._key(labels or {}), 0.0)
@@ -70,16 +78,18 @@ class Histogram(Metric):
         self.counts: dict[tuple, list[int]] = {}
         self.sums: dict[tuple, float] = {}
         self.totals: dict[tuple, int] = {}
+        self._lock = threading.Lock()
 
     def observe(self, value: float, labels: Optional[dict] = None) -> None:
         k = self._key(labels or {})
-        if k not in self.counts:
-            self.counts[k] = [0] * len(self.buckets)
-        for i, b in enumerate(self.buckets):
-            if value <= b:
-                self.counts[k][i] += 1
-        self.sums[k] = self.sums.get(k, 0.0) + value
-        self.totals[k] = self.totals.get(k, 0) + 1
+        with self._lock:
+            if k not in self.counts:
+                self.counts[k] = [0] * len(self.buckets)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.counts[k][i] += 1
+            self.sums[k] = self.sums.get(k, 0.0) + value
+            self.totals[k] = self.totals.get(k, 0) + 1
 
     def count(self, labels: Optional[dict] = None) -> int:
         return self.totals.get(self._key(labels or {}), 0)

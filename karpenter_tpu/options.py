@@ -58,9 +58,13 @@ class Options:
     preference_policy: str = "Respect"  # Respect | Ignore
     min_values_policy: str = "Strict"  # Strict | BestEffort
     solve_timeout_seconds: float = 60.0  # provisioner.go:366
+    tpu_claim_slot_div: int = 4  # SchedulerOptions.claim_slot_div
     # disruption
     disruption_poll_seconds: float = 10.0  # disruption/controller.go:69
     multinode_consolidation_timeout_seconds: float = 60.0
+    # termination reconciler pool width (termination/controller.go:58-60
+    # scales 100->5000 in the reference; 1 keeps the sim deterministic)
+    termination_workers: int = 1
     # lifecycle liveness TTLs (lifecycle/liveness.go)
     launch_ttl_seconds: float = 300.0
     registration_ttl_seconds: float = 900.0
@@ -73,6 +77,11 @@ class Options:
     # set (0 = pick a free port); None = no HTTP server (tests, benchmarks)
     probe_port: "int | None" = None
     enable_profiling: bool = False
+    # HA: when lease_path is set, step() acts only while holding the lease
+    # (operator.go:157-182 leader election); standbys keep informers warm
+    leader_elect_lease_path: "str | None" = None
+    leader_elect_lease_seconds: float = 15.0
+    leader_elect_renew_seconds: float = 5.0
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
 
     @classmethod
@@ -96,6 +105,11 @@ class Options:
         f("KARPENTER_KUBE_CLIENT_BURST", int, "kube_client_burst")
         f("KARPENTER_LOG_LEVEL", str, "log_level")
         f("KARPENTER_PROBE_PORT", int, "probe_port")
+        f("KARPENTER_TERMINATION_WORKERS", int, "termination_workers")
+        f("KARPENTER_TPU_CLAIM_SLOT_DIV", int, "tpu_claim_slot_div")
+        f("KARPENTER_LEADER_ELECT_LEASE_PATH", str, "leader_elect_lease_path")
+        f("KARPENTER_LEADER_ELECT_LEASE_SECONDS", float, "leader_elect_lease_seconds")
+        f("KARPENTER_LEADER_ELECT_RENEW_SECONDS", float, "leader_elect_renew_seconds")
         gates = env.get("KARPENTER_FEATURE_GATES")
         if gates:
             opts.feature_gates = FeatureGates.parse(gates)

@@ -169,6 +169,10 @@ class SchedulerOptions:
     reserved_capacity_enabled: bool = False  # ReservedCapacity feature gate
     reserved_offering_strict: bool = False
     timeout_seconds: Optional[float] = None  # Solve budget (provisioner.go:366)
+    # TPU solver: initial claim-slot pool = pods/claim_slot_div (pow2-
+    # bucketed, grows on kernel overflow). Smaller pools cut per-step
+    # candidate screens; too small forces an overflow re-solve.
+    claim_slot_div: int = 4
 
 
 @dataclass

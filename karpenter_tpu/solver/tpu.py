@@ -24,7 +24,6 @@ equivalent to the reference's requeue-at-end + stall detection.
 from __future__ import annotations
 
 import collections
-import os
 import time as time_mod
 from typing import Optional
 
@@ -313,10 +312,7 @@ class TpuScheduler:
         # bench mix averages ~5 pods/claim), so start small and grow on the
         # kernel's overflow signal — smaller N cuts every per-step candidate
         # screen. Worst case (one pod per claim) ends at _pow2(len(pods)).
-        try:
-            div = max(1, int(os.environ.get("KARPENTER_TPU_CLAIM_SLOT_DIV", "4")))
-        except ValueError:
-            div = 4
+        div = max(1, int(self.opts.claim_slot_div))
         N = min(_pow2(max(64, (len(pods) + div - 1) // div)), _pow2(len(pods)))
         while True:
             st = self._init_state(problem, N)

@@ -390,3 +390,222 @@ def test_solve_profile_phases():
     out = prof.render()
     assert "a" in out and "b" in out
     assert prof.phases["a"] >= 0.0
+
+
+def test_leader_election_lease_lifecycle(tmp_path):
+    """leaderelection.py: single holder, renewal, expiry takeover, and
+    voluntary release (operator.go:157-182 Lease semantics)."""
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.leaderelection import LeaderElector
+
+    clock = FakeClock()
+    lease = str(tmp_path / "lease.json")
+    a = LeaderElector(lease, identity="a", lease_duration=15, renew_period=5, clock=clock)
+    b = LeaderElector(lease, identity="b", lease_duration=15, renew_period=5, clock=clock)
+
+    assert a.ensure() is True
+    assert b.ensure() is False  # a holds
+    assert a.holder() == "a" and b.holder() == "a"
+
+    # renewal keeps the lease across many periods
+    for _ in range(5):
+        clock.advance(5.0)
+        assert a.ensure() is True
+        assert b.ensure() is False
+
+    # a goes silent -> b takes over after the lease expires
+    clock.advance(15.1)
+    assert b.ensure() is True
+    assert b.holder() == "b"
+    # the deposed holder notices: ensure() re-reads and fails
+    assert a.ensure() is False
+    assert a.is_leader is False
+
+    # voluntary release hands off without waiting out the lease
+    b.release()
+    assert a.ensure() is True
+
+
+def test_leader_election_fences_stale_holder(tmp_path):
+    """A holder that cannot renew within its own lease duration stops
+    counting itself leader even before a successor appears."""
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.leaderelection import LeaderElector
+
+    clock = FakeClock()
+    a = LeaderElector(
+        str(tmp_path / "l.json"), identity="a",
+        lease_duration=15, renew_period=5, clock=clock,
+    )
+    assert a.ensure()
+    assert a.is_leader
+    clock.advance(15.1)  # wedged: no ensure() happened in time
+    assert a.is_leader is False
+
+
+def test_operator_standby_until_leader(tmp_path):
+    """An Operator configured with a lease acts only while holding it: the
+    standby provisions nothing; after the leader releases, the standby's
+    next step takes over and provisions."""
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.operator import Operator
+    from karpenter_tpu.options import Options
+
+    lease = str(tmp_path / "op-lease.json")
+    clock = FakeClock()
+
+    def opts():
+        return Options(
+            leader_elect_lease_path=lease,
+            leader_elect_lease_seconds=30,
+            leader_elect_renew_seconds=5,
+        )
+
+    leader = Operator(clock=clock, force_oracle=True, options=opts())
+    standby = Operator(clock=clock, force_oracle=True, options=opts())
+    leader.step()  # acquires
+    standby.step()  # sees the lease held
+    assert leader.elector.is_leader
+    assert not standby.elector.is_leader
+
+    standby.kube.create("NodePool", fixtures.node_pool(name="default"))
+    fixtures.reset_rng(5)
+    for p in fixtures.make_generic_pods(4):
+        standby.kube.create("Pod", p)
+    for _ in range(20):
+        leader.step(0.0)  # keep renewing (the clock is shared)
+        standby.step(2.0)
+    assert not standby.kube.list("Node"), "standby must not provision"
+
+    leader.stop()  # releases the lease
+    for _ in range(30):
+        standby.step(2.0)
+    assert standby.elector.is_leader
+    assert standby.kube.list("Node"), "new leader provisions"
+    standby.stop()
+
+
+def test_parallelize_until_drains_and_collects_errors():
+    """utils/workerpool.py: every index runs even when siblings fail; the
+    caller gets per-index errors (reconcile semantics — no abort)."""
+    import threading
+
+    from karpenter_tpu.utils.workerpool import parallelize_until
+
+    seen = set()
+    lock = threading.Lock()
+
+    def fn(i):
+        with lock:
+            seen.add(i)
+        if i % 3 == 0:
+            raise RuntimeError(f"boom-{i}")
+
+    errs = parallelize_until(4, 10, fn)
+    assert seen == set(range(10))
+    assert [i for i, e in enumerate(errs) if e is not None] == [0, 3, 6, 9]
+    # sequential path: same contract
+    seen.clear()
+    errs = parallelize_until(1, 4, fn)
+    assert seen == set(range(4)) and errs[0] is not None and errs[1] is None
+
+
+def test_concurrent_termination_drains_fleet():
+    """The termination reconciler pool (termination/controller.go:58-60):
+    deleting many nodes with a multi-worker pool converges to the same
+    fully-drained end state as the sequential pool."""
+    op = small_op(options=Options(termination_workers=8))
+    assert op.termination.workers == 8
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    fixtures.reset_rng(9)
+    for p in fixtures.make_generic_pods(12):
+        op.kube.create("Pod", p)
+    op.run_until_settled(max_ticks=40)
+    nodes = op.kube.list("Node")
+    assert nodes
+
+    for n in nodes:
+        op.kube.delete("Node", n.name)
+    for _ in range(40):
+        op.step(2.0)
+        if not op.kube.list("Node"):
+            break
+    assert not op.kube.list("Node"), "all nodes must finish termination"
+
+
+def test_parallel_eviction_respects_shared_pdb():
+    """Two deleting nodes whose pods share a maxUnavailable=1 PDB: a
+    multi-worker termination round must start at most ONE eviction — the
+    eviction path serializes PDB accounting (terminator/eviction.go:93 is
+    a single queue in the reference for exactly this reason)."""
+    from karpenter_tpu.api.objects import PodDisruptionBudget, LabelSelector
+
+    op = small_op(options=Options(termination_workers=8))
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    fixtures.reset_rng(9)
+    # two pods forced onto separate nodes via hostname anti-affinity
+    from karpenter_tpu.api.objects import PodAffinityTerm
+
+    for i in range(2):
+        op.kube.create(
+            "Pod",
+            fixtures.pod(
+                name=f"guarded-{i}",
+                labels={"app": "guarded"},
+                requests={"cpu": "100m"},
+                pod_anti_requirements=[
+                    PodAffinityTerm(
+                        topology_key=well_known.HOSTNAME_LABEL_KEY,
+                        label_selector=LabelSelector(
+                            match_labels={"app": "guarded"}
+                        ),
+                    )
+                ],
+            ),
+        )
+    op.run_until_settled(max_ticks=40)
+    nodes = op.kube.list("Node")
+    assert len(nodes) == 2
+    from karpenter_tpu.api.objects import PodPhase
+
+    for p in op.kube.list("Pod"):
+        p.phase = PodPhase.RUNNING
+        op.kube.update("Pod", p)
+    op.kube.create(
+        "PodDisruptionBudget",
+        PodDisruptionBudget(
+            metadata=ObjectMeta(name="guard"),
+            selector=LabelSelector(match_labels={"app": "guarded"}),
+            max_unavailable="1",
+        ),
+    )
+    for n in nodes:
+        op.kube.delete("Node", n.name)
+    op.termination.reconcile_all()  # ONE parallel round
+    terminating = [p for p in op.kube.list("Pod") if p.terminating]
+    assert len(terminating) <= 1, "PDB allows one disruption, not two"
+    assert len(terminating) == 1, "one eviction should have proceeded"
+
+
+def test_short_lease_challenger_cannot_depose_long_lease_holder(tmp_path):
+    """Expiry is judged by the HOLDER's advertised lease duration (stored
+    in the record), not the challenger's config — a 15s-lease candidate
+    must not steal from a healthy 60s-lease holder mid-lease."""
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.leaderelection import LeaderElector
+
+    clock = FakeClock()
+    lease = str(tmp_path / "lease.json")
+    long_ = LeaderElector(
+        lease, identity="long", lease_duration=60, renew_period=20, clock=clock
+    )
+    short = LeaderElector(
+        lease, identity="short", lease_duration=15, renew_period=5, clock=clock
+    )
+    assert long_.ensure()
+    clock.advance(16.0)  # past short's duration, well inside long's
+    assert short.ensure() is False
+    assert long_.is_leader
+    # but once the holder's OWN duration lapses, the takeover is legal
+    clock.advance(60.0)
+    assert short.ensure() is True

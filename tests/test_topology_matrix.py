@@ -883,3 +883,279 @@ def test_affinity_empty_namespace_selector_matches_implicit_namespaces():
     ]
     assert len(aff) == 1
     assert "team-x" in aff[0].namespaces
+
+
+# ---------------------------------------------------------------------------
+# 12. restricted domain universes (topology_test.go zone-subset scenarios)
+
+
+@pytest.mark.parametrize("nzones", [1, 2, 3])
+def test_spread_with_zone_subset_pools(nzones):
+    """The domain universe is NodePool ∩ instance-type requirements
+    (topology.go:105 buildDomainGroups): restricting the pool to a zone
+    subset caps the spread's denominator."""
+    zones = ["test-zone-a", "test-zone-b", "test-zone-c"][:nzones]
+
+    def pools():
+        return [
+            fixtures.node_pool(
+                name="subset",
+                requirements=[NodeSelectorRequirement(ZONE, Operator.IN, zones)],
+            )
+        ]
+
+    run_parity(problem(lambda: spread_pods(3 * nzones, key=ZONE), pools_fn=pools))
+
+
+def test_spread_across_disjoint_pools_unions_domains():
+    """Two pools covering disjoint zone sets: the group's universe is the
+    union, so pods spread across all four zones via different pools."""
+
+    def pools():
+        return [
+            fixtures.node_pool(
+                name="ab",
+                requirements=[
+                    NodeSelectorRequirement(
+                        ZONE, Operator.IN, ["test-zone-a", "test-zone-b"]
+                    )
+                ],
+            ),
+            fixtures.node_pool(
+                name="cd",
+                requirements=[
+                    NodeSelectorRequirement(
+                        ZONE, Operator.IN, ["test-zone-c", "test-zone-d"]
+                    )
+                ],
+            ),
+        ]
+
+    r = run_parity(problem(lambda: spread_pods(8, key=ZONE), pools_fn=pools))
+    assert not r.pod_errors
+
+
+# ---------------------------------------------------------------------------
+# 13. selector shapes
+
+
+def test_spread_selector_matches_no_pods():
+    """A spread whose selector matches nobody (including its own pods)
+    keeps every domain count at zero — pods land unconstrained."""
+
+    def pods():
+        return spread_pods(6, key=ZONE, labels={"app": "web"}) + [
+            fixtures.pod(
+                name=f"free-{i}",
+                labels={"app": "web"},
+                requests={"cpu": "100m"},
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=ZONE,
+                        when_unsatisfiable=WhenUnsatisfiable.DO_NOT_SCHEDULE,
+                        label_selector=LabelSelector(
+                            match_labels={"app": "nobody-has-this"}
+                        ),
+                    )
+                ],
+            )
+            for i in range(6)
+        ]
+
+    r = run_parity(problem(pods))
+    assert not r.pod_errors
+
+
+def test_spread_selector_with_not_in_expression():
+    from karpenter_tpu.api.objects import LabelSelectorRequirement
+
+    def pods():
+        out = []
+        for i in range(8):
+            rev = "canary" if i % 4 == 0 else "stable"
+            out.append(
+                fixtures.pod(
+                    name=f"ni-{i}",
+                    labels={"app": "web", "rev": rev},
+                    requests={"cpu": "100m"},
+                    topology_spread_constraints=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=ZONE,
+                            when_unsatisfiable=WhenUnsatisfiable.DO_NOT_SCHEDULE,
+                            label_selector=LabelSelector(
+                                match_labels={"app": "web"},
+                                match_expressions=[
+                                    LabelSelectorRequirement(
+                                        key="rev",
+                                        operator=Operator.NOT_IN,
+                                        values=["canary"],
+                                    )
+                                ],
+                            ),
+                        )
+                    ],
+                )
+            )
+        return out
+
+    run_parity(problem(pods))
+
+
+def test_affinity_with_exists_expression():
+    from karpenter_tpu.api.objects import LabelSelectorRequirement
+
+    def pods():
+        anchor = fixtures.pod(
+            name="anchor", labels={"db": "primary"}, requests={"cpu": "100m"}
+        )
+        followers = [
+            fixtures.pod(
+                name=f"f-{i}",
+                labels={"app": "web"},
+                requests={"cpu": "100m"},
+                pod_requirements=[
+                    PodAffinityTerm(
+                        topology_key=HOSTNAME,
+                        label_selector=LabelSelector(
+                            match_expressions=[
+                                LabelSelectorRequirement(
+                                    key="db", operator=Operator.EXISTS, values=[]
+                                )
+                            ]
+                        ),
+                    )
+                ],
+            )
+            for i in range(4)
+        ]
+        return [anchor] + followers
+
+    r = run_parity(problem(pods))
+    assert not r.pod_errors
+
+
+# ---------------------------------------------------------------------------
+# 14. namespaces on anti-affinity
+
+
+def test_anti_affinity_scoped_to_namespace_list():
+    """Anti-affinity with an explicit namespaces list only fences pods in
+    those namespaces; same-labeled pods elsewhere co-locate freely."""
+
+    def pods():
+        fenced = []
+        for i in range(2):
+            p = fixtures.pod(
+                name=f"prod-{i}", labels={"app": "redis"}, requests={"cpu": "100m"}
+            )
+            p.metadata.namespace = "production"
+            fenced.append(p)
+        guard = fixtures.pod(
+            name="guard",
+            labels={"app": "web"},
+            requests={"cpu": "100m"},
+            pod_anti_requirements=[
+                PodAffinityTerm(
+                    topology_key=HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": "redis"}),
+                    namespaces=["production"],
+                )
+            ],
+        )
+        guard.metadata.namespace = "production"
+        # same labels in default ns: invisible to the guard's term
+        free = [
+            fixtures.pod(
+                name=f"dev-{i}", labels={"app": "redis"}, requests={"cpu": "100m"}
+            )
+            for i in range(2)
+        ]
+        return fenced + [guard] + free
+
+    r = run_parity(problem(pods))
+    assert not r.pod_errors
+
+
+# ---------------------------------------------------------------------------
+# 15. combined zonal spread + hostname anti-affinity on one pod
+
+
+@pytest.mark.parametrize("n", [4, 9])
+def test_zonal_spread_plus_hostname_anti(n):
+    def pods():
+        labels = {"app": "combo2"}
+        return [
+            fixtures.pod(
+                name=f"za-{i}",
+                labels=dict(labels),
+                requests={"cpu": "100m"},
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=ZONE,
+                        when_unsatisfiable=WhenUnsatisfiable.DO_NOT_SCHEDULE,
+                        label_selector=LabelSelector(match_labels=dict(labels)),
+                    )
+                ],
+                pod_anti_requirements=[
+                    PodAffinityTerm(
+                        topology_key=HOSTNAME,
+                        label_selector=LabelSelector(match_labels=dict(labels)),
+                    )
+                ],
+            )
+            for i in range(n)
+        ]
+
+    run_parity(problem(pods))
+
+
+# ---------------------------------------------------------------------------
+# 16. hostname spread at higher skews
+
+
+@pytest.mark.parametrize("max_skew,n", [(2, 8), (3, 12)])
+def test_hostname_spread_packs_to_skew(max_skew, n):
+    r = run_parity(
+        problem(lambda: spread_pods(n, key=HOSTNAME, max_skew=max_skew))
+    )
+    assert not r.pod_errors
+
+
+# ---------------------------------------------------------------------------
+# 17. existing nodes seed domain counts
+
+
+def test_min_domains_with_existing_zone_nodes():
+    """Existing nodes register their zones as live domains; minDomains
+    within reach schedules cleanly."""
+
+    def views():
+        return [
+            StateNodeView(
+                name=f"seed-{z}",
+                labels={
+                    ZONE: z,
+                    HOSTNAME: f"seed-{z}",
+                    well_known.INSTANCE_TYPE_LABEL_KEY: "c-2x-amd64-linux",
+                    CAPACITY: "on-demand",
+                    well_known.OS_LABEL_KEY: "linux",
+                    well_known.ARCH_LABEL_KEY: "amd64",
+                    well_known.NODEPOOL_LABEL_KEY: "default",
+                },
+                available={"cpu": 1800, "memory": 3 * 1024**3 * 1000, "pods": 20_000},
+                capacity={"cpu": 2000, "memory": 4 * 1024**3 * 1000},
+                initialized=True,
+            )
+            for z in ("test-zone-a", "test-zone-b", "test-zone-c")
+        ]
+
+    r = run_parity(
+        problem(
+            lambda: spread_pods(9, key=ZONE, max_skew=1, min_domains=3),
+            views_fn=views,
+        )
+    )
+    assert not r.pod_errors
