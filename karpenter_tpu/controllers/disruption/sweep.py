@@ -28,15 +28,21 @@ Gates (fall back to the sequential prefix scan when violated): nodepool
 limits, reserved capacity — anything where per-prefix state diverges
 beyond availability and topology counts.
 
-Measured honestly (BENCH_DETAIL.json c4): the vmapped scan currently LOSES
-to the sequential binary search (~5x at 1-2k nodes) because vmap batches
-the kernel's inner control flow into execute-both-branches selects and
-multiplies every per-step tensor by the prefix count; routing the batch
-through the bulk run kernel was tried and measured WORSE for the same
-reason (~10 all-branch bulk iterations x 100-wide operands). The honest
-default strategy therefore stays "binary" (consolidation.py); this module
-is the capability + its conformance harness, and the path to making it win
-is a dedicated batched kernel without per-element control flow.
+Measured honestly (BENCH_DETAIL.json c4; re-measured round 3 after the
+E-slot pow2 bucketing made TPU probes share compiled shapes): at 2k nodes
+x 100 prefixes, all three strategies agree on the largest feasible prefix,
+and the ORACLE binary search wins wall-clock (~2.6s) — each probe's
+simulation is small (a few hundred pods), so the vmapped sweep (~49s,
+vmap turns per-element control flow into execute-both-branches selects x
+100 and carries every prefix's 2k existing-node rows) and the TPU-probe
+binary (~20s, ~1s of fixed tunnel/encode cost per probe) both lose.
+Routing the batch through the bulk run kernel was tried and measured
+WORSE for the same all-branch reason. The honest default therefore stays
+"binary" with oracle probes (consolidation.py); TPU probes pay off only
+when per-probe simulations are heavy (large reschedule sets), and the
+path to a sweep win is a dedicated batched kernel whose per-prefix state
+is deltas (disabled candidate slots + topology count diffs), not a full
+State copy.
 """
 
 from __future__ import annotations

@@ -162,11 +162,16 @@ class Operator:
         if self.opts.leader_elect_lease_path:
             from karpenter_tpu.leaderelection import LeaderElector
 
+            # lease timestamps are persisted and compared ACROSS process
+            # lifetimes, so only a wall clock is valid there — RealClock is
+            # monotonic (epoch = host boot) and would wedge every candidate
+            # in standby after a reboot. The sim's FakeClock is fine: tests
+            # control it explicitly and share it between candidates.
             self.elector = LeaderElector(
                 self.opts.leader_elect_lease_path,
                 lease_duration=self.opts.leader_elect_lease_seconds,
                 renew_period=self.opts.leader_elect_renew_seconds,
-                clock=self.clock,
+                clock=self.clock if isinstance(self.clock, FakeClock) else None,
             )
         self.node_metrics = NodeMetricsController(self.cluster)
         self.nodepool_metrics = NodePoolMetricsController(self.kube)

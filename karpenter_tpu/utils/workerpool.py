@@ -23,26 +23,28 @@ from typing import Callable, Optional
 
 def parallelize_until(
     workers: int, n: int, fn: Callable[[int], None]
-) -> list[Optional[BaseException]]:
+) -> list[Optional[Exception]]:
     """k8s.io/client-go workqueue.ParallelizeUntil: run fn(0..n-1) on at
     most `workers` threads; always drains every index. Returns the
     per-index exception (or None) so the caller decides requeue semantics
     — reconcile errors must not abort sibling reconciles."""
-    errs: list[Optional[BaseException]] = [None] * n
+    errs: list[Optional[Exception]] = [None] * n
     if n == 0:
         return errs
     if workers <= 1:
         for i in range(n):
             try:
                 fn(i)
-            except BaseException as e:  # noqa: BLE001 — collected, not dropped
+            # Exception only: KeyboardInterrupt/SystemExit must keep
+            # propagating or the control loop becomes un-interruptible
+            except Exception as e:
                 errs[i] = e
         return errs
 
     def run(i: int) -> None:
         try:
             fn(i)
-        except BaseException as e:  # noqa: BLE001
+        except Exception as e:
             errs[i] = e
 
     with ThreadPoolExecutor(max_workers=min(workers, n)) as pool:

@@ -749,3 +749,284 @@ def test_orchestration_rollback_on_replacement_failure():
         sn = op.cluster.node_by_name(c.name)
         assert sn is not None and not sn.marked_for_deletion
     assert not op.disruption.queue.busy
+
+
+# ---------------------------------------------------------------------------
+# candidate-gate matrix (statenode.go:202-260 ValidateNodeDisruptable)
+
+
+def test_do_not_disrupt_node_annotation_blocks_candidacy():
+    op = settled_operator(n_pods=2)
+    mark_consolidatable(op)
+    node = op.kube.list("Node")[0]
+    node.metadata.annotations[well_known.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+    op.kube.update("Node", node)
+    cands = build_candidates(op.kube, op.cluster, op.cloud, op.clock, lambda c: True)
+    assert node.name not in [c.name for c in cands]
+
+
+def test_nominated_node_blocks_candidacy():
+    """A node holding a fresh scheduling nomination (statenode.go:431
+    20s window) is off-limits to disruption until the window lapses."""
+    op = settled_operator(n_pods=2)
+    mark_consolidatable(op)
+    cands = build_candidates(op.kube, op.cluster, op.cloud, op.clock, lambda c: True)
+    assert cands
+    name = cands[0].name
+    op.cluster.node_by_name(name).nominate(op.clock.now())
+    cands2 = build_candidates(op.kube, op.cluster, op.cloud, op.clock, lambda c: True)
+    assert name not in [c.name for c in cands2]
+    op.clock.advance(25.0)  # window closes
+    cands3 = build_candidates(op.kube, op.cluster, op.cloud, op.clock, lambda c: True)
+    assert name in [c.name for c in cands3]
+
+
+def test_pdb_fully_blocked_pod_blocks_candidacy():
+    """maxUnavailable=0 makes every covered pod non-evictable; its node
+    must never become a candidate (helpers.go:174 GetCandidates)."""
+    from karpenter_tpu.api.objects import LabelSelector, ObjectMeta, PodDisruptionBudget
+
+    op = settled_operator(n_pods=2, pod_kw=dict(labels={"app": "frozen"}))
+    mark_consolidatable(op)
+    op.kube.create(
+        "PodDisruptionBudget",
+        PodDisruptionBudget(
+            metadata=ObjectMeta(name="freeze"),
+            selector=LabelSelector(match_labels={"app": "frozen"}),
+            max_unavailable="0",
+        ),
+    )
+    cands = build_candidates(op.kube, op.cluster, op.cloud, op.clock, lambda c: True)
+    pod_nodes = {p.node_name for p in op.kube.list("Pod")}
+    assert not any(c.name in pod_nodes for c in cands)
+
+
+def test_candidates_sorted_by_disruption_cost():
+    """consolidation.go:127 sortCandidates: cheapest-to-move first; pod
+    priority and do-not-disrupt preferences raise the cost."""
+    op = settled_operator(n_pods=0)
+    # two single-pod nodes: one carries a high-priority pod
+    from karpenter_tpu.api.objects import PodAffinityTerm, LabelSelector
+
+    anti = [
+        PodAffinityTerm(
+            topology_key=well_known.HOSTNAME_LABEL_KEY,
+            label_selector=LabelSelector(match_labels={"spread": "x"}),
+        )
+    ]
+    op.kube.create(
+        "Pod",
+        fixtures.pod(
+            name="cheap", labels={"spread": "x"},
+            requests={"cpu": "500m"}, pod_anti_requirements=[t for t in anti],
+        ),
+    )
+    expensive = fixtures.pod(
+        name="precious", labels={"spread": "x"},
+        requests={"cpu": "500m"}, pod_anti_requirements=[t for t in anti],
+    )
+    expensive.priority = 1_000_000
+    op.kube.create("Pod", expensive)
+    assert op.run_until_settled(max_ticks=40) < 40
+    for p in op.kube.list("Pod"):
+        p.phase = PodPhase.RUNNING
+        op.kube.update("Pod", p)
+    mark_consolidatable(op)
+    cands = build_candidates(op.kube, op.cluster, op.cloud, op.clock, lambda c: True)
+    assert len(cands) == 2
+    ordered = sorted(cands, key=lambda c: (c.disruption_cost, c.name))
+    pod_of = {p.node_name: p.name for p in op.kube.list("Pod")}
+    assert pod_of[ordered[0].name] == "cheap"
+    assert pod_of[ordered[1].name] == "precious"
+
+
+# ---------------------------------------------------------------------------
+# method precedence (controller.go:98 NewMethods order)
+
+
+def test_emptiness_precedes_consolidation():
+    """One controller round on a cluster with BOTH an empty node and an
+    underutilized node must pick the emptiness command first
+    (controller.go:98 NewMethods order)."""
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+
+    anti = [
+        PodAffinityTerm(
+            topology_key=well_known.HOSTNAME_LABEL_KEY,
+            label_selector=LabelSelector(match_labels={"spread": "e"}),
+        )
+    ]
+    op = settled_operator(
+        n_pods=2,
+        pod_kw=dict(
+            labels={"spread": "e"}, pod_anti_requirements=[t for t in anti]
+        ),
+    )
+    assert len(op.kube.list("Node")) == 2
+    # empty one node by deleting its pod; the other stays underutilized
+    pods = op.kube.list("Pod")
+    op.kube.delete("Pod", pods[0].name)
+    mark_consolidatable(op)
+    np = op.kube.list("NodePool")[0]
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    op.clock.advance(op.opts.disruption_poll_seconds + 1)
+    op.disruption.reconcile()
+    pending = op.disruption._pending_validation
+    assert pending is not None
+    _, cmd = pending
+    assert cmd.reason == "empty", f"emptiness must win, got {cmd.reason}"
+
+
+# ---------------------------------------------------------------------------
+# drift budget gating (drift.go:38-116)
+
+
+def test_drift_respects_budget_per_round():
+    """With a nodes=1 budget, one disruption round may only taint/replace
+    one drifted node even when several are drifted (drift.go:38-116
+    budget gating)."""
+    # hostname anti-affinity forces one node per pod -> a real multi-node
+    # cluster on the small universe
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+
+    anti = [
+        PodAffinityTerm(
+            topology_key=well_known.HOSTNAME_LABEL_KEY,
+            label_selector=LabelSelector(match_labels={"spread": "d"}),
+        )
+    ]
+    op = settled_operator(
+        n_pods=3,
+        pod_kw=dict(
+            labels={"spread": "d"}, pod_anti_requirements=[t for t in anti]
+        ),
+    )
+    claims = op.kube.list("NodeClaim")
+    assert len(claims) >= 2
+    from karpenter_tpu.api.objects import Budget
+
+    # drift EVERY claim via a template-hash change (drift.go:50 hash drift)
+    np = op.kube.list("NodePool")[0]
+    np.template.labels["fleet"] = "v2"
+    np.disruption.budgets = [Budget(nodes="1")]
+    op.kube.update("NodePool", np)
+    op.nodepool_hash.reconcile_all()
+    mark_consolidatable(op)
+    op.claim_conditions.reconcile_all()
+    drifted = [
+        c for c in op.kube.list("NodeClaim")
+        if c.status.conditions.get(COND_DRIFTED) == "True"
+    ]
+    assert len(drifted) == len(claims)
+    op.clock.advance(op.opts.disruption_poll_seconds + 1)
+    op.disruption.reconcile()
+    pending = op.disruption._pending_validation
+    assert pending is not None
+    _, cmd = pending
+    assert len(cmd.candidates) == 1, "budget caps the round at 1 node"
+
+
+# ---------------------------------------------------------------------------
+# stale-taint cleanup (controller.go:143-157)
+
+
+def test_stale_disruption_taint_cleaned():
+    """A node carrying the disruption taint without being part of any
+    in-flight or pending command gets un-tainted on the next round."""
+    from karpenter_tpu.controllers.state import DISRUPTED_TAINT
+
+    op = settled_operator(n_pods=2)
+    mark_consolidatable(op)
+    node = op.kube.list("Node")[0]
+    node.taints = list(node.taints) + [DISRUPTED_TAINT]
+    op.kube.update("Node", node)
+    op.clock.advance(op.opts.disruption_poll_seconds + 1)
+    op.disruption.reconcile()
+    node = op.kube.get("Node", node.name)
+    assert DISRUPTED_TAINT not in node.taints, "stale taint must be removed"
+
+
+# ---------------------------------------------------------------------------
+# replace waits for replacement readiness (queue.go:137-249)
+
+
+def test_originals_survive_until_replacement_initialized():
+    """During a replace command, the original nodes must keep running
+    until every replacement claim is registered+initialized; only then are
+    originals deleted."""
+    op = settled_operator(n_pods=3)
+    claims = op.kube.list("NodeClaim")
+    np = op.kube.list("NodePool")[0]
+    np.template.labels["fleet"] = "v2"  # hash drift -> replace path
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    op.nodepool_hash.reconcile_all()
+    mark_consolidatable(op)
+    op.claim_conditions.reconcile_all()
+    old_names = {c.name for c in claims}
+
+    op.clock.advance(op.opts.disruption_poll_seconds + 1)
+    op.disruption.reconcile()  # proposes
+    op.clock.advance(16.0)  # validation TTL
+    op.disruption.reconcile()  # validates + starts the command
+    assert op.disruption.queue.busy
+    # the instant the command starts, originals still exist while the
+    # replacement claim is launching
+    live = {c.name for c in op.kube.list("NodeClaim")}
+    assert old_names & live, "originals must not vanish before replacements"
+    replacements_launching = live - old_names
+    assert replacements_launching, "replacement claims must be created"
+
+    # drive to completion: replacements initialize, originals drain away
+    for _ in range(60):
+        op.step(2.0)
+        live = {c.name for c in op.kube.list("NodeClaim")}
+        if live and not (live & old_names):
+            break
+    assert live and not (live & old_names)
+    assert all(p.node_name for p in op.kube.list("Pod"))
+
+
+# ---------------------------------------------------------------------------
+# consolidation decision shape (consolidation.go:137-230)
+
+
+def test_consolidation_deletes_when_capacity_remains():
+    """computeConsolidation: when the surviving nodes can absorb every
+    rescheduled pod, the command is a pure DELETE (no replacements,
+    consolidation.go:184). Built in two waves so the cluster genuinely
+    holds two nodes with slack on the first."""
+    op = settled_operator(
+        n_pods=3, pod_kw=dict(requests={"cpu": "600m", "memory": "200Mi"})
+    )
+    # wave 2: one more pod after the first node filled -> second node
+    op.kube.create(
+        "Pod",
+        fixtures.pod(name="late", requests={"cpu": "600m", "memory": "200Mi"}),
+    )
+    assert op.run_until_settled(max_ticks=40) < 40
+    for p in op.kube.list("Pod"):
+        if p.phase != PodPhase.RUNNING:
+            p.phase = PodPhase.RUNNING
+            op.kube.update("Pod", p)
+    if len(op.kube.list("Node")) < 2:
+        pytest.skip("universe packed both waves onto one node")
+    # free most of node 1 so the late pod can move there
+    for name in ("w-0", "w-1"):
+        op.kube.delete("Pod", name)
+    mark_consolidatable(op)
+    np = op.kube.list("NodePool")[0]
+    np.disruption.budgets[0].nodes = "100%"
+    op.kube.update("NodePool", np)
+    from karpenter_tpu.controllers.disruption.consolidation import (
+        SingleNodeConsolidation,
+    )
+
+    sc = SingleNodeConsolidation(
+        op.kube, op.cluster, op.cloud, op.clock, options=op.opts, force_oracle=True
+    )
+    cmds = sc.compute_commands()
+    assert cmds, "an underutilized multi-node cluster must yield a command"
+    assert cmds[0].decision == DECISION_DELETE
+    assert not cmds[0].replacements
