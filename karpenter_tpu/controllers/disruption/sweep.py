@@ -31,11 +31,11 @@ beyond availability and topology counts.
 Measured honestly (BENCH_DETAIL.json c4; re-measured round 3 after the
 E-slot pow2 bucketing made TPU probes share compiled shapes): at 2k nodes
 x 100 prefixes, all three strategies agree on the largest feasible prefix,
-and the ORACLE binary search wins wall-clock (~2.6s) — each probe's
-simulation is small (a few hundred pods), so the vmapped sweep (~49s,
+and the ORACLE binary search wins wall-clock (2.8s) — each probe's
+simulation is small (a few hundred pods), so the vmapped sweep (39s,
 vmap turns per-element control flow into execute-both-branches selects x
 100 and carries every prefix's 2k existing-node rows) and the TPU-probe
-binary (~20s, ~1s of fixed tunnel/encode cost per probe) both lose.
+binary (12.5s, ~1s of fixed tunnel/encode cost per probe) both lose.
 Routing the batch through the bulk run kernel was tried and measured
 WORSE for the same all-branch reason. The honest default therefore stays
 "binary" with oracle probes (consolidation.py); TPU probes pay off only

@@ -107,6 +107,7 @@ def node_pool(
     requirements: Optional[list[NodeSelectorRequirement]] = None,
     labels: Optional[dict[str, str]] = None,
     taints: Optional[list[Taint]] = None,
+    startup_taints: Optional[list[Taint]] = None,
     limits: Optional[dict[str, str | int]] = None,
     weight: int = 0,
     consolidate_after_seconds: float = 0.0,
@@ -122,6 +123,7 @@ def node_pool(
             requirements=list(reqs),
             labels=dict(labels or {}),
             taints=list(taints or []),
+            startup_taints=list(startup_taints or []),
         ),
         disruption=Disruption(
             consolidate_after_seconds=consolidate_after_seconds,

@@ -25,9 +25,13 @@ def parallelize_until(
     workers: int, n: int, fn: Callable[[int], None]
 ) -> list[Optional[Exception]]:
     """k8s.io/client-go workqueue.ParallelizeUntil: run fn(0..n-1) on at
-    most `workers` threads; always drains every index. Returns the
-    per-index exception (or None) so the caller decides requeue semantics
-    — reconcile errors must not abort sibling reconciles."""
+    most `workers` threads, draining every index through ordinary
+    failures. Returns the per-index Exception (or None) so the caller
+    decides requeue semantics — reconcile errors must not abort sibling
+    reconciles. Interrupts (KeyboardInterrupt/SystemExit) DO propagate:
+    in the serial path they abort the drain immediately; in the threaded
+    path already-submitted indices finish before the interrupt re-raises
+    at result consumption."""
     errs: list[Optional[Exception]] = [None] * n
     if n == 0:
         return errs

@@ -278,3 +278,103 @@ def test_namespace_selector_wired_through_operator():
     assert a.node_name == f.node_name, (
         "hostname affinity across a selector-matched namespace must co-locate"
     )
+
+
+def test_scheduling_gates_defer_provisioning():
+    """A pod with schedulingGates is not provisionable until the gates are
+    cleared (pod/scheduling.go:42 IsProvisionable excludes gated pods)."""
+    op = small_operator()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    gated = fixtures.pod(name="gated", requests={"cpu": "500m"})
+    gated.scheduling_gates = ["example.com/wait"]
+    op.kube.create("Pod", gated)
+    for _ in range(20):
+        op.step(2.0)
+    assert not op.kube.list("Node"), "gated pod must not trigger capacity"
+
+    stored = op.kube.get("Pod", "gated")
+    stored.scheduling_gates = []
+    op.kube.update("Pod", stored)
+    for _ in range(30):
+        op.step(2.0)
+        if op.kube.get("Pod", "gated").node_name:
+            break
+    assert op.kube.get("Pod", "gated").node_name, "ungated pod provisions"
+
+
+def test_terminal_and_terminating_pods_do_not_provision():
+    """Succeeded/Failed/terminating pods never open capacity
+    (pod/scheduling.go IsProvisionable)."""
+    from karpenter_tpu.api.objects import PodPhase
+
+    op = small_operator()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    done = fixtures.pod(name="done", requests={"cpu": "500m"})
+    done.phase = PodPhase.SUCCEEDED
+    op.kube.create("Pod", done)
+    dying = fixtures.pod(name="dying", requests={"cpu": "500m"})
+    dying.terminating = True
+    op.kube.create("Pod", dying)
+    for _ in range(20):
+        op.step(2.0)
+    assert not op.kube.list("Node")
+
+
+def test_nodepool_opt_out_selector():
+    """A pod requiring karpenter.sh/nodepool DoesNotExist opts out of
+    provisioning entirely (provisioner.go:504-586 pod validation)."""
+    from karpenter_tpu.api.objects import NodeSelectorRequirement, Operator as Oper
+
+    op = small_operator()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    optout = fixtures.pod(
+        name="optout",
+        requests={"cpu": "500m"},
+        node_requirements=[
+            NodeSelectorRequirement(
+                well_known.NODEPOOL_LABEL_KEY, Oper.DOES_NOT_EXIST, []
+            )
+        ],
+    )
+    op.kube.create("Pod", optout)
+    for _ in range(20):
+        op.step(2.0)
+    assert not op.kube.list("Node"), "opt-out pod must not be provisioned for"
+
+
+def test_termination_grace_period_force_drains_past_pdb():
+    """terminator.go:140-176 + termination/controller.go:289: once the
+    claim's terminationGracePeriod expires, the drain turns forced and
+    evicts even PDB-blocked pods, so a stuck node cannot wedge forever."""
+    op = small_operator()
+    fixtures.reset_rng(9)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    pod = fixtures.pod(name="guarded", labels={"app": "db"}, requests={"cpu": "100m"})
+    op.kube.create("Pod", pod)
+    op.run_until_settled(max_ticks=30)
+    stored = op.kube.get("Pod", "guarded")
+    stored.phase = PodPhase.RUNNING
+    op.kube.update("Pod", stored)
+    op.kube.create(
+        "PodDisruptionBudget",
+        PodDisruptionBudget(
+            metadata=fixtures.pod(name="pdb-db2").metadata,
+            selector=LabelSelector(match_labels={"app": "db"}),
+            max_unavailable="0",
+        ),
+    )
+    claim = op.kube.list("NodeClaim")[0]
+    claim.termination_grace_period_seconds = 30.0
+    op.kube.update("NodeClaim", claim)
+    node = op.kube.list("Node")[0]
+    op.kube.delete("Node", node.name)
+    # within the grace period: blocked
+    op.termination.reconcile_all()
+    assert not op.kube.get("Pod", "guarded").terminating
+    # past it: forced
+    op.clock.advance(31.0)
+    for _ in range(10):
+        op.step(2.0)
+        if op.kube.try_get("Node", node.name) is None:
+            break
+    assert op.kube.try_get("Node", node.name) is None, "forced drain completes"

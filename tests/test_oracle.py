@@ -341,3 +341,113 @@ def test_preference_pods_all_schedule():
     pods = fixtures.make_preference_pods(50)
     results = build(pods, instance_types=fake.instance_types(50)).solve(pods)
     assert results.all_pods_scheduled()
+
+
+# ---------------------------------------------------------------------------
+# daemonset overhead (scheduler.go:806 isDaemonPodCompatible + daemon
+# resource accounting in NewScheduler)
+
+
+def test_daemonset_overhead_reduces_node_capacity():
+    """A 1-vCPU daemonset rides every node: a 1.5-vCPU workload pod then
+    needs >= 2.5 vCPU allocatable, so the 1- and 2-vCPU types must drop
+    out of the claim's surviving options."""
+    pods = [fixtures.pod(name="w", requests={"cpu": "1500m"})]
+    daemon = fixtures.pod(name="ds", requests={"cpu": "1"})
+    node_pools = [fixtures.node_pool()]
+    its = fake.instance_types(5)  # 1..5 vCPU
+    by_pool = {np.name: InstanceTypes(its) for np in node_pools}
+    topology = Topology(node_pools, by_pool, pods)
+    s = Scheduler(node_pools, by_pool, topology, daemonset_pods=[daemon])
+    results = s.solve(pods)
+    assert results.all_pods_scheduled()
+    claim = results.new_node_claims[0]
+    names = {it.name for it in claim.instance_type_options}
+    assert "fake-it-0" not in names and "fake-it-1" not in names
+    assert names, "larger types must survive"
+    # the claim's accounted requests include the daemon overhead
+    assert claim.daemon_resources.get(res.CPU, 0) == 1000
+
+
+def test_daemonset_with_node_selector_counts_only_on_matching_templates():
+    """scheduler.go:806: a daemonset constrained to zone-1 adds overhead
+    only to templates that can land in zone-1."""
+    from karpenter_tpu.api import labels as well_known
+
+    daemon = fixtures.pod(
+        name="ds",
+        requests={"cpu": "1"},
+        node_selector={well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-1"},
+    )
+    pools = [
+        fixtures.node_pool(
+            name="z1",
+            requirements=[
+                NodeSelectorRequirement(
+                    well_known.TOPOLOGY_ZONE_LABEL_KEY, Operator.IN, ["test-zone-1"]
+                )
+            ],
+        ),
+        fixtures.node_pool(
+            name="z2",
+            requirements=[
+                NodeSelectorRequirement(
+                    well_known.TOPOLOGY_ZONE_LABEL_KEY, Operator.IN, ["test-zone-2"]
+                )
+            ],
+        ),
+    ]
+    pods = [fixtures.pod(name="w", requests={"cpu": "100m"})]
+    its = fake.instance_types_assorted()
+    by_pool = {np.name: InstanceTypes(its) for np in pools}
+    topology = Topology(pools, by_pool, pods)
+    s = Scheduler(pools, by_pool, topology)
+    s2 = Scheduler(pools, by_pool, Topology(pools, by_pool, pods), daemonset_pods=[daemon])
+    overhead = {nct.nodepool_name: r for nct, r in s2.daemon_overhead.items()}
+    assert overhead["z1"].get(res.CPU, 0) == 1000
+    assert overhead["z2"].get(res.CPU, 0) == 0
+    assert s.daemon_overhead  # baseline sanity: templates exist
+
+
+def test_startup_taints_do_not_block_scheduling():
+    """Startup taints (nodepool.go spec.template.startupTaints) gate node
+    INITIALIZATION, not scheduling: pods need no toleration for them."""
+    from karpenter_tpu.api.objects import Taint, TaintEffect
+
+    np_ = fixtures.node_pool(
+        startup_taints=[
+            Taint(key="node.cilium.io/agent-not-ready", value="true",
+                  effect=TaintEffect.NO_SCHEDULE)
+        ]
+    )
+    pods = [fixtures.pod(requests={"cpu": "1"})]
+    results = build(pods, node_pools=[np_]).solve(pods)
+    assert results.all_pods_scheduled()
+    claim = results.new_node_claims[0]
+    assert claim.template.startup_taints, "claim must carry the startup taints"
+
+
+def test_host_port_conflict_forces_second_node():
+    """Two pods publishing the same hostPort cannot share a node
+    (hostportusage.go:35); everything else about them fits together."""
+    a = fixtures.pod(name="a", requests={"cpu": "100m"})
+    b = fixtures.pod(name="b", requests={"cpu": "100m"})
+    a.host_ports = [("", "TCP", 8080)]
+    b.host_ports = [("", "TCP", 8080)]
+    results = build([a, b]).solve([a, b])
+    assert results.all_pods_scheduled()
+    assert len([c for c in results.new_node_claims if c.pods]) == 2
+
+
+def test_pods_resource_caps_pods_per_node():
+    """The 'pods' resource is a packing dimension like cpu/memory
+    (fake types carry pods=10*(i+1))."""
+    its = fake.instance_types(1)  # 1 vCPU, pods=10
+    pods = [
+        fixtures.pod(name=f"tiny-{i}", requests={"cpu": "10m"}) for i in range(15)
+    ]
+    results = build(pods, instance_types=its).solve(pods)
+    assert results.all_pods_scheduled()
+    filled = [len(c.pods) for c in results.new_node_claims if c.pods]
+    assert sorted(filled, reverse=True)[0] <= 10
+    assert len(filled) == 2
