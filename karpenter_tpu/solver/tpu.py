@@ -159,6 +159,89 @@ def _slice_decode_state(st, n2: int, ecols: int):
     return _slice_decode_cached(st, n2=n2, ecols=ecols)
 
 
+_dedup_decode_cached = None
+_slice_rows_cached = None
+# dedup fetch pays an extra round trip; below this bucket the raw fetch is
+# cheaper (tests lower it to drive the dedup path on small problems)
+_DEDUP_DECODE_MIN = 2048
+
+
+def _dedup_decode_state(st, n2: int, ecols: int):
+    """Large-solve decode fetch: claims overwhelmingly share identical
+    (requirement-row, surviving-types) pairs — a 50k-pod solve has ~10k
+    claim slots but only tens of distinct rows, and the tunnel charges per
+    byte. Device side: pack creq+alive into one u32 matrix, sort by two
+    independent 32-bit row hashes, compact FULL-ROW-compared uniques to
+    the front, and hand back (uniques kept on device for a sliced second
+    fetch, inverse index, small raw fields). Hash collisions only place
+    equal rows non-adjacently — costing duplicate "uniques", never
+    merging distinct rows — so the result is exact.
+
+    Returns (small, compact): small is a device pytree to fetch whole;
+    compact stays on device until the caller knows n_uniq."""
+    global _dedup_decode_cached
+    if _dedup_decode_cached is None:
+        import jax
+        import jax.numpy as jnp
+
+        def impl(st, n2, ecols):
+            r = st.creq
+            bits = lambda a: jax.lax.bitcast_convert_type(a[:n2], jnp.uint32)
+            cols = [
+                r.mask[:n2],
+                r.exmask[:n2],
+                r.other[:n2].astype(jnp.uint32),
+                r.notin[:n2].astype(jnp.uint32),
+                r.defined[:n2].astype(jnp.uint32),
+                bits(r.gt),
+                bits(r.lt),
+                bits(r.minv),
+                st.alive[:n2],
+            ]
+            rows = jnp.concatenate(cols, axis=1)  # [n2, C] u32
+            C = rows.shape[1]
+            j = jnp.arange(C, dtype=jnp.uint32)
+            m1 = (2 * j + 1) * jnp.uint32(2654435761)
+            m2 = (2 * j + 1) * jnp.uint32(2246822519)
+            h1 = jnp.sum(rows * m1[None, :], axis=1, dtype=jnp.uint32)
+            h2 = jnp.sum((rows + j[None, :]) * m2[None, :], axis=1,
+                         dtype=jnp.uint32)
+            order = jnp.lexsort((h2, h1))
+            sm = rows[order]
+            is_new = jnp.concatenate(
+                [jnp.ones(1, bool), jnp.any(sm[1:] != sm[:-1], axis=1)]
+            )
+            dest = jnp.cumsum(is_new) - 1  # [n2]
+            compact = jnp.zeros_like(sm).at[dest].set(sm)
+            inv = jnp.zeros(n2, jnp.int32).at[order].set(dest.astype(jnp.int32))
+            n_uniq = dest[-1] + 1
+            small = (
+                n_uniq,
+                inv,
+                st.crequests[:n2],
+                st.tmpl[:n2],
+                st.eavail,
+                st.ereq,
+                st.v_cnt,
+                st.h_cnt[:, :ecols],
+            )
+            return small, compact
+
+        _dedup_decode_cached = jax.jit(impl, static_argnames=("n2", "ecols"))
+    return _dedup_decode_cached(st, n2=n2, ecols=ecols)
+
+
+def _slice_rows(compact, u2: int):
+    global _slice_rows_cached
+    if _slice_rows_cached is None:
+        import jax
+
+        _slice_rows_cached = jax.jit(
+            lambda m, u2: m[:u2], static_argnames=("u2",)
+        )
+    return _slice_rows_cached(compact, u2=u2)
+
+
 def _popcount_rows(seg: np.ndarray) -> np.ndarray:
     return np.unpackbits(
         seg.astype("<u4").view(np.uint8), axis=-1
@@ -633,16 +716,60 @@ class TpuScheduler:
         N = st.active.shape[0]
         n2 = min(_pow2(max(n_claims, 1), floor=64), N)
         E = st.eavail.shape[0]
-        st = jax.device_get(
-            _slice_decode_state(st, n2=n2, ecols=E + n2)
+        if n2 >= _DEDUP_DECODE_MIN:
+            # big solve: row-dedup fetch (the extra round trip for the
+            # unique count amortizes against MBs of duplicate rows)
+            small, compact = _dedup_decode_state(st, n2=n2, ecols=E + n2)
+            (
+                n_uniq, inv, crequests, tmpl, eavail, ereq_t, v_cnt, h_cnt
+            ) = jax.device_get(small)
+            n_uniq = int(n_uniq)
+            u2 = min(_pow2(max(n_uniq, 1), floor=64), n2)
+            uniq = np.asarray(jax.device_get(_slice_rows(compact, u2)))
+            # unpack [u2, C] u32 back into the creq fields + alive, then
+            # rematerialize full-size arrays through the inverse index —
+            # host memcpy is cheap; only the tunnel bytes mattered
+            TW = vocab.total_words
+            Kk = vocab.num_keys
+            IW = uniq.shape[1] - 2 * TW - 6 * Kk
+            o = 0
+
+            def take(w):
+                nonlocal o
+                out = uniq[:, o : o + w]
+                o += w
+                return out
+
+            creq_u = Reqs(
+                mask=take(TW),
+                exmask=take(TW),
+                other=take(Kk).astype(bool),
+                notin=take(Kk).astype(bool),
+                defined=take(Kk).astype(bool),
+                gt=take(Kk).view(np.int32),
+                lt=take(Kk).view(np.int32),
+                minv=take(Kk).view(np.int32),
+            )
+            alive_u = take(IW)
+            inv = np.asarray(inv)
+            creq = Reqs(*(np.ascontiguousarray(a[inv]) for a in creq_u))
+            alive = np.ascontiguousarray(alive_u[inv])
+        else:
+            (
+                creq, crequests, alive, tmpl, eavail, ereq_t, v_cnt, h_cnt
+            ) = jax.device_get(_slice_decode_state(st, n2=n2, ecols=E + n2))
+            creq = Reqs(*(np.asarray(a) for a in creq))
+            alive = np.asarray(alive)
+        # shared tail: both branches produced (creq, alive); the small raw
+        # fields convert identically
+        crequests = np.asarray(crequests)
+        tmpl = np.asarray(tmpl)
+        eavail = np.asarray(eavail)
+        ereq = Reqs(*(np.asarray(a) for a in ereq_t))
+        st = _DecodeView(
+            np.int32(n_claims), creq, crequests, alive, tmpl,
+            eavail, ereq, np.asarray(v_cnt), np.asarray(h_cnt),
         )
-        st = _DecodeView(np.int32(n_claims), *st)
-        creq = Reqs(*(np.asarray(a) for a in st.creq))
-        crequests = np.asarray(st.crequests)
-        alive = np.asarray(st.alive)
-        tmpl = np.asarray(st.tmpl)
-        eavail = np.asarray(st.eavail)
-        ereq = Reqs(*(np.asarray(a) for a in st.ereq))
 
         # global type table order (same construction as encode_problem)
         type_idx: dict[int, int] = {}

@@ -365,3 +365,34 @@ def test_adaptive_slots_overflow_retry():
         return [np_], {"default": its}, pods, None, None
 
     assert_parity(run_both(make))
+
+
+def test_dedup_decode_path_parity(monkeypatch):
+    """The large-solve decode fetch (device-side row dedup + inverse
+    rematerialization, tpu._dedup_decode_state) must be byte-equivalent to
+    the raw fetch: same claims, same requirements, same surviving types.
+    The threshold is lowered so a normal-size problem rides the dedup
+    path."""
+    from karpenter_tpu.solver import tpu as tpu_mod
+
+    def solve_once():
+        fixtures.reset_rng(77)
+        its = construct_instance_types(sizes=[2, 8])
+        pool = fixtures.node_pool(name="default")
+        pods = fixtures.make_diverse_pods(120)
+        topo = Topology([pool], {"default": its}, pods)
+        s = TpuScheduler([pool], {"default": its}, topo)
+        r = s.solve(pods)
+        def claim_view(c):
+            return (
+                tuple(sorted(p.name for p in c.pods)),
+                repr(sorted(str(c.requirements.get(k)) for k in c.requirements)),
+                tuple(sorted(it.name for it in c.instance_type_options)),
+                tuple(sorted(c.requests.items())),
+            )
+        return sorted(claim_view(c) for c in r.new_node_claims if c.pods)
+
+    raw = solve_once()
+    monkeypatch.setattr(tpu_mod, "_DEDUP_DECODE_MIN", 64)
+    dedup = solve_once()
+    assert raw == dedup
