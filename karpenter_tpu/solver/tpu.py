@@ -368,16 +368,13 @@ class TpuScheduler:
         # FFD order shared with the oracle (solver/ordering.py): cpu desc,
         # memory desc, class signature, creation, uid — class grouping makes
         # identical pods contiguous for the run kernel
-        from karpenter_tpu.solver.ordering import ffd_sort_key
-
         with prof.phase("order"):
             data = self.oracle.cached_pod_data
             for p in pods:
                 self.oracle._update_cached_pod_data(p)
-            order = sorted(
-                range(len(pods)),
-                key=lambda i: ffd_sort_key(pods[i], data[pods[i].uid].requests),
-            )
+            from karpenter_tpu.solver.ordering import ffd_order
+
+            order = ffd_order(pods, lambda pd: data[pd.uid].requests)
 
         from karpenter_tpu.solver import tpu_kernel as K
         from karpenter_tpu.solver import tpu_runs as KR

@@ -612,19 +612,34 @@ def solve_runs(tb: Tables, st: State, rx: RunX, seq, next_seq, n_valid):
                     ),
                     0,
                 )
-                # surviving types per touched claim depend on its fill
+                # surviving types per touched claim depend on its fill —
+                # but only TWO fill levels exist (cstar for full claims, a
+                # partial remainder on the last), so compute per LEVEL and
+                # select per claim instead of vmapping an O(N x I) filter
+                # (at 16k slots x 1k types that intermediate dominated the
+                # whole step)
+                last_fill = f - (ncl - 1) * cstar
                 fi_full = alive_m & (per >= cstar)
-                packs_by_fill = jax.vmap(
-                    lambda k: _pack(alive_m & (per >= k), IW)
-                )(fills)  # [N, IW]
-                cmax_by_fill = jax.vmap(
-                    lambda k: jnp.max(
-                        jnp.where((alive_m & (per >= k))[:, None], tb.ialloc, -INF_I),
-                        axis=0,
-                    )
-                )(fills)
-                alive = jnp.where(touched[:, None], packs_by_fill, st.alive)
-                cmax_alloc = jnp.where(touched[:, None], cmax_by_fill, st.cmax_alloc)
+                fi_last = alive_m & (per >= last_fill)
+                pack_full = _pack(fi_full, IW)
+                pack_last = _pack(fi_last, IW)
+                cmax_full = jnp.max(
+                    jnp.where(fi_full[:, None], tb.ialloc, -INF_I), axis=0
+                )
+                cmax_last = jnp.max(
+                    jnp.where(fi_last[:, None], tb.ialloc, -INF_I), axis=0
+                )
+                is_full = fills == cstar
+                alive = jnp.where(
+                    touched[:, None],
+                    jnp.where(is_full[:, None], pack_full[None], pack_last[None]),
+                    st.alive,
+                )
+                cmax_alloc = jnp.where(
+                    touched[:, None],
+                    jnp.where(is_full[:, None], cmax_full[None], cmax_last[None]),
+                    st.cmax_alloc,
+                )
                 finals_n = jax.tree.map(
                     lambda a: jnp.broadcast_to(a, (N,) + a.shape), final_n
                 )

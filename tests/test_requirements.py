@@ -243,3 +243,27 @@ def test_requirement_len():
     assert len(IN("k", "a", "b")) == 2
     assert len(NOT_IN("k", "a")) == sys.maxsize - 1
     assert len(DOES_NOT_EXIST("k")) == 0
+
+
+def test_ffd_order_equals_ffd_sort_key():
+    """ordering.ffd_order (vectorized lexsort) MUST stay identical to
+    sorting by ffd_sort_key — the oracle and the TPU path sort with the
+    same key or parity breaks (CLAUDE.md invariant). Includes long
+    caller-set uids sharing a prefix (the truncation trap) and exact
+    request ties."""
+    from karpenter_tpu.solver.ordering import ffd_order, ffd_sort_key
+    from karpenter_tpu.testing import fixtures
+    from karpenter_tpu.utils import resources as res
+
+    for seed in (3, 31):
+        fixtures.reset_rng(seed)
+        pods = fixtures.make_diverse_pods(300) + fixtures.make_preference_pods(30)
+        # adversarial uids: longer than any fixed dtype guess, shared prefix
+        for i, p in enumerate(pods[:40]):
+            p.metadata.uid = "x" * 44 + f"{(97 - i):04d}"
+        reqs = {p.uid: res.requests_for_pods([p]) for p in pods}
+        want = sorted(
+            range(len(pods)), key=lambda i: ffd_sort_key(pods[i], reqs[pods[i].uid])
+        )
+        got = ffd_order(pods, lambda p: reqs[p.uid])
+        assert got == want
