@@ -88,30 +88,37 @@ def _typeok_chunk(ireq, va, preq_chunk, iw: int):
 _gather_xs_cached = None
 
 
-def _gather_xs(tables, idx, valid):
+def _gather_xs(tables, idx, n):
     """Device-side PodX assembly: gather class rows + per-pod selection
-    rows for a round's pod indices."""
+    rows for a round's pod indices. `idx` is the only per-pod upload of a
+    round (compact dtype); validity derives from `n` on device."""
     global _gather_xs_cached
     if _gather_xs_cached is None:
         import jax
 
-        def impl(tables, idx, valid):
+        def impl(tables, idx, n):
+            import jax.numpy as jnp
+
             from karpenter_tpu.solver import tpu_kernel as K
 
             # Heavy rows live per REQUIREMENT-class (pod_class_key without
             # the request vector — few distinct values even when every pod's
             # requests differ); only the request vectors are per
-            # encode-class. This keeps the per-solve host->device upload
-            # proportional to distinct requirement shapes, not pods — the
-            # tunnel transfer of per-pod requirement rows used to dominate
-            # solve wall-clock.
+            # encode-class. Selection rows live per (namespace, labels)
+            # srow. This keeps the per-solve host->device upload
+            # proportional to distinct shapes, not pods — the tunnel
+            # transfer of per-pod rows used to dominate solve wall-clock.
             (
                 preq_r, typeok_r, tol_t_r, tol_e_r,
                 kind_r, gid_r, tsel_r, rcls_of,
-                prequests_c, cls, sel_v, sel_h, inv_h, own_h,
+                prequests_c, cls, srow, sel_rows_v, sel_rows_h,
+                inv_c, own_c,
             ) = tables
-            ci = cls[idx]
+            idx = idx.astype(jnp.int32)
+            ci = cls[idx].astype(jnp.int32)
             ri = rcls_of[ci]
+            si = srow[idx].astype(jnp.int32)
+            valid = jnp.arange(idx.shape[0], dtype=jnp.int32) < n
             return K.PodX(
                 preq=Reqs(*(a[ri] for a in preq_r)),
                 prequests=prequests_c[ci],
@@ -121,15 +128,54 @@ def _gather_xs(tables, idx, valid):
                 topo_kind=kind_r[ri],
                 topo_gid=gid_r[ri],
                 topo_sel=tsel_r[ri],
-                sel_v=sel_v[idx],
-                sel_h=sel_h[idx],
-                inv_h=inv_h[idx],
-                own_h=own_h[idx],
+                sel_v=sel_rows_v[si],
+                sel_h=sel_rows_h[si],
+                inv_h=inv_c[ci],
+                own_h=own_c[ci],
                 valid=valid,
             )
 
         _gather_xs_cached = jax.jit(impl)
-    return _gather_xs_cached(tables, idx, valid)
+    return _gather_xs_cached(tables, idx, n)
+
+
+_run_arrays_cached = None
+
+
+def _run_arrays(cls_d, bulk_c, aff_c, idx, n):
+    """Device-side RunX driver arrays (is_head/bulk/aff/run_rem) from the
+    round's index array + per-class flags — the [P]-sized host builds and
+    uploads these replaced cost ~0.4s/solve in tunnel bytes at 50k pods.
+    Padding positions (>= n) are their own single-pod runs with bulk off,
+    matching the former host construction."""
+    global _run_arrays_cached
+    if _run_arrays_cached is None:
+        import jax
+
+        def impl(cls_d, bulk_c, aff_c, idx, n):
+            import jax.numpy as jnp
+
+            P = idx.shape[0]
+            pos = jnp.arange(P, dtype=jnp.int32)
+            valid = pos < n
+            ci = cls_d[idx.astype(jnp.int32)].astype(jnp.int32)
+            prev = jnp.roll(ci, 1)
+            is_head = (pos == 0) | (ci != prev) | ~valid
+            big = jnp.int32(2**31 - 1)
+            arr = jnp.where(is_head, pos, big)
+            m = jax.lax.cummin(arr, reverse=True)  # m[i] = min(arr[i:])
+            nh = jnp.concatenate(
+                [m[1:], jnp.full((1,), P, jnp.int32)]
+            )  # next head strictly after i (padding is all heads)
+            # a tail run with no head after it ends at P (nh would be the
+            # big sentinel when the batch exactly fills P)
+            run_rem = jnp.minimum(nh, P) - pos
+            bulk = bulk_c[ci] & valid
+            aff = aff_c[ci] & valid
+            return is_head, bulk, aff, run_rem
+
+        _run_arrays_cached = jax.jit(impl)
+    return _run_arrays_cached(cls_d, bulk_c, aff_c, idx, n)
 
 
 _slice_decode_cached = None
@@ -253,7 +299,7 @@ def _bulk_gates(p: EncodedProblem) -> bool:
     solver/tpu_runs.py module docstring). When any fails, every pod runs
     the exact per-pod step inside the same kernel — correctness never
     depends on these."""
-    if (p.treq.minv != -1).any() or (p.preq.minv != -1).any():
+    if (p.treq.minv != -1).any() or (p.preq_c.minv != -1).any():
         return False
     if p.num_existing and (p.ereq.minv != -1).any():
         return False
@@ -293,17 +339,17 @@ def _bulk_gates(p: EncodedProblem) -> bool:
     return True
 
 
-def _bulk_pod_flags(p: EncodedProblem, gates_ok: bool) -> np.ndarray:
-    """[P] bool — pod's class admits bulk phases. Only self-selecting
+def _bulk_class_flags(p: EncodedProblem, gates_ok: bool) -> np.ndarray:
+    """[NC] bool — class admits bulk phases. Only self-selecting
     zone-family spread/anti constraints are dynamic beyond what the kernel's
     per-slot hostname budgets model (their domain counts move mid-run), so
     only those force the exact per-pod path."""
     from karpenter_tpu.solver.tpu_problem import TOPO_ANTI_V, TOPO_SPREAD_V
 
-    P = len(p.pods)
+    NC = len(p.class_reps)
     if not gates_ok:
-        return np.zeros(P, bool)
-    dyn_v = np.isin(p.ptopo_kind, (TOPO_SPREAD_V, TOPO_ANTI_V)) & p.ptopo_sel
+        return np.zeros(NC, bool)
+    dyn_v = np.isin(p.ptopo_kind_c, (TOPO_SPREAD_V, TOPO_ANTI_V)) & p.ptopo_sel_c
     return ~dyn_v.any(axis=1)
 
 
@@ -367,14 +413,11 @@ class TpuScheduler:
 
         # FFD order shared with the oracle (solver/ordering.py): cpu desc,
         # memory desc, class signature, creation, uid — class grouping makes
-        # identical pods contiguous for the run kernel
+        # identical pods contiguous for the run kernel. Sort columns come
+        # from the per-class tables (one PodData per class, shared by every
+        # pod of the class); only timestamps/uids are gathered per pod.
         with prof.phase("order"):
-            data = self.oracle.cached_pod_data
-            for p in pods:
-                self.oracle._update_cached_pod_data(p)
-            from karpenter_tpu.solver.ordering import ffd_order
-
-            order = ffd_order(pods, lambda pd: data[pd.uid].requests)
+            order = self._order_pods(problem)
 
         from karpenter_tpu.solver import tpu_kernel as K
         from karpenter_tpu.solver import tpu_runs as KR
@@ -384,9 +427,16 @@ class TpuScheduler:
             self._typeok = self._pod_typeok(problem, tb)
             self._upload_pod_tables(problem)
         gates_ok = _bulk_gates(problem)
-        self._bulk_flags = _bulk_pod_flags(problem, gates_ok)
-        use_runs = bool(self._bulk_flags.any())
+        self._bulk_flags_c = _bulk_class_flags(problem, gates_ok)
+        use_runs = bool(self._bulk_flags_c.any())
         self.last_used_runs = use_runs  # introspection for tests/bench
+        if use_runs:
+            import jax.numpy as jnp
+
+            self._runflags_dev = (
+                jnp.asarray(self._bulk_flags_c),
+                jnp.asarray(self._aff_c),
+            )
 
         # Claim slots: most solves create far fewer claims than pods (the
         # bench mix averages ~5 pods/claim), so start small and grow on the
@@ -409,8 +459,8 @@ class TpuScheduler:
                     break
                 if use_runs:
                     with prof.phase("pod_xs"):
-                        xs = self._pod_xs(problem, pending)
-                        rx = self._run_x(problem, pending, xs)
+                        xs, idx_d, n_d = self._pod_xs_with_idx(problem, pending)
+                        rx = self._run_x(xs, idx_d, n_d)
                     with prof.phase("kernel"):
                         st, seq, next_seq, got_kinds, got_slots, got_over, iters = (
                             KR.solve_runs(
@@ -447,72 +497,53 @@ class TpuScheduler:
         with prof.phase("decode"):
             return self._decode(problem, st, kinds, slots, timed_out)
 
-    def _run_x(self, p: EncodedProblem, indices: list[int], xs):
-        """Build the run-kernel driver arrays for a pending subsequence."""
-        import jax.numpy as jnp
-
-        from karpenter_tpu.solver import tpu_runs as KR
-
-        n = len(indices)
-        P_pad = xs.valid.shape[0]
-        idx = np.asarray(indices, dtype=np.int64)
-        cls = p.pod_class[idx]
-        is_head = np.ones(P_pad, bool)
-        is_head[1:n] = cls[1:] != cls[:-1]
-        run_rem = np.ones(P_pad, np.int32)
-        # distance to the run's end, inclusive (vectorized over boundaries)
-        heads = np.flatnonzero(is_head[:n])
-        ends = np.zeros(n, np.int64)
-        bounds = np.append(heads[1:], n)
-        ends[heads] = bounds - 1
-        np.maximum.accumulate(ends, out=ends)  # fill within runs
-        run_rem[:n] = (ends - np.arange(n) + 1).astype(np.int32)
-        bulk = np.zeros(P_pad, bool)
-        bulk[:n] = self._bulk_flags[idx]
-        from karpenter_tpu.solver.tpu_problem import TOPO_AFFINITY_H, TOPO_AFFINITY_V
-
-        aff = np.zeros(P_pad, bool)
-        aff[:n] = np.isin(
-            p.ptopo_kind[idx], (TOPO_AFFINITY_V, TOPO_AFFINITY_H)
-        ).any(axis=1)
-        return KR.RunX(
-            x=xs,
-            is_head=jnp.asarray(is_head),
-            bulk=jnp.asarray(bulk),
-            aff=jnp.asarray(aff),
-            run_rem=jnp.asarray(run_rem),
+    def _order_pods(self, p: EncodedProblem) -> list:
+        """FFD order from class columns; also points cached_pod_data at one
+        shared PodData per class (requests/requirements are class fields),
+        so the former per-pod Requirements.from_pod pass disappears."""
+        from karpenter_tpu.solver.ordering import (
+            ffd_order_cols,
+            pod_class_signature,
         )
 
-    def _rclass_map(self, p: EncodedProblem):
-        """(rcls_of [NC] i32, rreps list of pod indices) — requirement-class
-        structure over the encode classes. Two encode classes share a
-        requirement class when their pods' pod_class_key (everything but
-        the request vector) is equal; every device table except prequests
-        depends only on the requirement class."""
-        if getattr(self, "_rmap_for", None) is p:
-            return self._rmap
-
-        from karpenter_tpu.solver.ordering import pod_class_key
-
+        pods = p.pods
+        data = self.oracle.cached_pod_data
+        pd_c = []
+        for i in p.class_reps:
+            self.oracle._update_cached_pod_data(pods[i])
+            pd_c.append(data[pods[i].uid])
+        cls_list = p.pod_class.tolist()
+        for pod, c in zip(pods, cls_list):
+            data[pod.uid] = pd_c[c]
+        cpu_c = np.fromiter(
+            (pd.requests.get(res.CPU, 0) for pd in pd_c), np.int64, len(pd_c)
+        )
+        mem_c = np.fromiter(
+            (pd.requests.get(res.MEMORY, 0) for pd in pd_c), np.int64, len(pd_c)
+        )
+        sig_c = np.fromiter(
+            (pod_class_signature(pods[i]) for i in p.class_reps),
+            np.int64,
+            len(p.class_reps),
+        )
         cls = p.pod_class
-        NC = int(cls.max()) + 1 if len(cls) else 0
-        reps = np.zeros(NC, dtype=np.int64)
-        reps[cls[::-1]] = np.arange(len(cls) - 1, -1, -1)
-        rkey_to_id: dict = {}
-        rcls_of = np.zeros(NC, dtype=np.int32)
-        rreps: list[int] = []
-        for c in range(NC):
-            i = int(reps[c])
-            k = pod_class_key(p.pods[i])
-            rid = rkey_to_id.get(k)
-            if rid is None:
-                rid = len(rreps)
-                rkey_to_id[k] = rid
-                rreps.append(i)
-            rcls_of[c] = rid
-        self._rmap = (rcls_of, rreps, reps)
-        self._rmap_for = p
-        return self._rmap
+        ts_list = [pod.metadata.creation_timestamp for pod in pods]
+        uids = [pod.uid for pod in pods]
+        return ffd_order_cols(cpu_c[cls], mem_c[cls], sig_c[cls], ts_list, uids)
+
+    def _run_x(self, xs, idx_d, n_d):
+        """Build the run-kernel driver arrays for a round — entirely on
+        device from the round's already-uploaded index array (see
+        _run_arrays). idx_d/n_d come from the _pod_xs_with_idx call that
+        produced xs."""
+        from karpenter_tpu.solver import tpu_runs as KR
+
+        cls_d = self._dev_tables[9]
+        bulk_d, aff_d = self._runflags_dev
+        is_head, bulk, aff, run_rem = _run_arrays(cls_d, bulk_d, aff_d, idx_d, n_d)
+        return KR.RunX(
+            x=xs, is_head=is_head, bulk=bulk, aff=aff, run_rem=run_rem
+        )
 
     def _pod_typeok(self, p: EncodedProblem, tb):
         """[NR, IW] u32 DEVICE array — per requirement-class, the instance
@@ -525,17 +556,16 @@ class TpuScheduler:
 
         I = p.num_types
         IW = max(1, (I + 31) // 32)
-        _, rreps, _ = self._rclass_map(p)
-        NR = len(rreps)
-        rr = np.asarray(rreps, dtype=np.int64)
+        cr = np.asarray(p.rclass_creps, dtype=np.int64)
+        NR = len(cr)
         chunks = []
         CH = 2048
         for lo in range(0, NR, CH):
             hi = min(lo + CH, NR)
             # pow2-pad chunks so compiled shapes are reused across solves
             pad_to = min(CH, _pow2(hi - lo))
-            idx = rr[np.arange(lo, lo + pad_to) % NR]
-            chunk = Reqs(*(jnp.asarray(a[idx]) for a in p.preq))
+            idx = cr[np.arange(lo, lo + pad_to) % NR]
+            chunk = Reqs(*(jnp.asarray(a[idx]) for a in p.preq_c))
             chunks.append(_typeok_chunk(tb.ireq, tb.va, chunk, iw=IW)[: hi - lo])
         if not chunks:
             return jnp.zeros((0, IW), jnp.uint32)
@@ -643,14 +673,15 @@ class TpuScheduler:
         """Ship pod tables to the device once per solve; per-round pod
         batches are then just an index array (the tunnel charges per byte).
         Heavy rows (requirements, type screens, tolerations, topology
-        ownership) upload per REQUIREMENT-class; only the request vectors
-        upload per encode-class, so a 10k-pod mix with 10k distinct request
-        vectors but a handful of requirement shapes ships KBs, not MBs."""
+        ownership) upload per REQUIREMENT-class; request vectors and
+        inverse-anti rows per encode-class; selection rows per unique
+        (namespace, labels). The only [P]-sized uploads are the class and
+        selection-row index columns, in the narrowest dtype that fits —
+        a 10k-pod mix with 10k distinct request vectors but a handful of
+        requirement shapes ships KBs, not MBs."""
         import jax.numpy as jnp
 
-        cls = p.pod_class
-        rcls_of, rreps, reps = self._rclass_map(p)
-        rr = np.asarray(rreps, dtype=np.int64)
+        cr = np.asarray(p.rclass_creps, dtype=np.int64)  # class idx per rclass
         Gv = max(len(p.vgroups), 1)
         Gh = max(len(p.hgroups), 1)
 
@@ -659,35 +690,54 @@ class TpuScheduler:
                 return a
             return np.zeros((a.shape[0], G), a.dtype)
 
+        def narrow(a):
+            return a.astype(np.uint16) if a.max(initial=0) < 65536 else a
+
         self._dev_tables = (
-            Reqs(*(jnp.asarray(a[rr]) for a in p.preq)),
+            Reqs(*(jnp.asarray(a[cr]) for a in p.preq_c)),
             # _pod_typeok is already per requirement-class on device
             self._typeok,
-            jnp.asarray(p.ptol_t[rr]),
-            jnp.asarray(p.ptol_e[rr]),
-            jnp.asarray(p.ptopo_kind[rr]),
-            jnp.asarray(p.ptopo_gid[rr]),
-            jnp.asarray(p.ptopo_sel[rr]),
-            jnp.asarray(rcls_of),
-            jnp.asarray(p.prequests[reps]),
-            jnp.asarray(cls.astype(np.int32)),
-            jnp.asarray(pad_g(p.psel_v, Gv)),
-            jnp.asarray(pad_g(p.psel_h, Gh)),
-            jnp.asarray(pad_g(p.pinv_h, Gh)),
-            jnp.asarray(pad_g(p.pown_h, Gh)),
+            jnp.asarray(p.ptol_t_c[cr]),
+            jnp.asarray(p.ptol_e_c[cr]),
+            jnp.asarray(p.ptopo_kind_c[cr]),
+            jnp.asarray(p.ptopo_gid_c[cr]),
+            jnp.asarray(p.ptopo_sel_c[cr]),
+            jnp.asarray(p.rcls_of),
+            jnp.asarray(p.prequests_c),
+            jnp.asarray(narrow(p.pod_class)),
+            jnp.asarray(narrow(p.srow)),
+            jnp.asarray(pad_g(p.sel_rows_v, Gv)),
+            jnp.asarray(pad_g(p.sel_rows_h, Gh)),
+            jnp.asarray(pad_g(p.pinv_h_c, Gh)),
+            jnp.asarray(pad_g(p.pown_h_c, Gh)),
+        )
+        from karpenter_tpu.solver.tpu_problem import (
+            TOPO_AFFINITY_H,
+            TOPO_AFFINITY_V,
         )
 
-    def _pod_xs(self, p: EncodedProblem, indices: list[int]):
+        aff_c = np.isin(
+            p.ptopo_kind_c, (TOPO_AFFINITY_V, TOPO_AFFINITY_H)
+        ).any(axis=1)
+        self._aff_c = aff_c
+
+    def _pod_xs_with_idx(self, p: EncodedProblem, indices: list[int]):
+        """(PodX, idx_d, n_d) — the run-driver arrays (_run_x) derive from
+        the same uploaded index array, so callers thread it through rather
+        than paying a second [P] upload."""
         import jax.numpy as jnp
 
         n = len(indices)
         P_pad = _pow2(n)
-        idx = np.array(indices + [0] * (P_pad - n), dtype=np.int32)
-        valid = np.zeros(P_pad, bool)
-        valid[:n] = True
-        return _gather_xs(
-            self._dev_tables, jnp.asarray(idx), jnp.asarray(valid)
-        )
+        dt = np.uint16 if len(p.pods) < 65536 else np.int32
+        idx = np.zeros(P_pad, dtype=dt)
+        idx[:n] = np.asarray(indices, dtype=dt)
+        idx_d = jnp.asarray(idx)
+        n_d = jnp.asarray(np.int32(n))
+        return _gather_xs(self._dev_tables, idx_d, n_d), idx_d, n_d
+
+    def _pod_xs(self, p: EncodedProblem, indices: list[int]):
+        return self._pod_xs_with_idx(p, indices)[0]
 
     # -- decoding --------------------------------------------------------
 
