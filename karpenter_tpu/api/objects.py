@@ -260,6 +260,24 @@ class Pod:
 
 
 @dataclass
+class VolumeAttachment:
+    """storagev1.VolumeAttachment, reduced to what node termination needs:
+    the attach-detach controller (external to this framework, simulated in
+    tests) deletes these after unmount; termination blocks instance
+    deletion until the node's attachments are gone (reference
+    node/termination/controller.go:223-252). volume_name matches the pod's
+    volume_claims entries (we key volumes by claim name — no PV objects)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    node_name: str = ""
+    volume_name: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
 class Node:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     provider_id: str = ""

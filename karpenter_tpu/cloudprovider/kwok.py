@@ -232,6 +232,11 @@ class KwokCloudProvider:
         labels[wk.CAPACITY_TYPE_LABEL_KEY] = offering.capacity_type()
         labels[wk.HOSTNAME_LABEL_KEY] = claim.name
         labels[PARTITION_LABEL_KEY] = offering.zone()
+        # the returned claim carries the resolved labels like the reference
+        # kwok provider's toNodeClaim(node) (kwok cloudprovider.go:84) —
+        # lifecycle's PopulateNodeClaimDetails merges them onto the stored
+        # claim, which RequirementsDrifted later diffs against the nodepool
+        claim.metadata.labels = dict(labels)
 
         node = Node(
             metadata=ObjectMeta(

@@ -6,9 +6,10 @@ leans on them (reference pkg/operator/operator.go, controller-runtime):
   conflict-requeue pattern in disruption/controller.go:146)
 - finalizers: delete() only marks deletion_timestamp while finalizers
   remain; objects vanish when the last finalizer is removed
-- watch: subscribers get (event_type, kind, obj) synchronously on commit —
-  the informer layer (controllers/state.py wire_informers) builds the
-  cluster cache from these, exactly like the reference's informer
+- watch: subscribers get (event_type, kind, obj) in commit order, on the
+  committing thread but AFTER the store lock is released (the _pump event
+  queue) — the informer layer (controllers/state.py wire_informers) builds
+  the cluster cache from these, exactly like the reference's informer
   controllers (pkg/controllers/state/informer/)
 
 Stored kinds are the framework's dataclasses (karpenter_tpu.api.objects):
@@ -96,6 +97,8 @@ class SimKube:
         # surface as Conflict — the same optimistic-concurrency contract
         # the real apiserver gives controller-runtime.
         self._lock = threading.RLock()
+        self._events: list[tuple[str, str, object]] = []
+        self._emitting = False  # guarded by self._lock
 
     # -- watch ------------------------------------------------------------
 
@@ -103,8 +106,48 @@ class SimKube:
         self._subscribers.append(fn)
 
     def _emit(self, event: str, kind: str, obj) -> None:
-        for fn in self._subscribers:
-            fn(event, kind, obj)
+        """Queue a watch event. Called under self._lock; delivery happens
+        in _pump AFTER the lock is released — a subscriber that blocks or
+        takes another lock must not deadlock against worker-pool
+        reconciles doing store CRUD, and subscriber work must not
+        serialize the store."""
+        self._events.append((event, kind, obj))
+
+    def _pump(self) -> None:
+        """Deliver queued events in commit order outside the lock. One
+        thread drains at a time (the _emitting flag), so global ordering
+        is preserved even when several workers mutate concurrently; a
+        subscriber that mutates the store re-queues and the draining
+        thread picks the new events up on the next loop."""
+        while True:
+            with self._lock:
+                if self._emitting or not self._events:
+                    return
+                self._emitting = True
+                batch = list(self._events)
+                self._events.clear()
+            try:
+                for event, kind, obj in batch:
+                    for fn in self._subscribers:
+                        try:
+                            fn(event, kind, obj)
+                        except Exception as e:  # noqa: BLE001
+                            # a broken subscriber must not swallow the rest
+                            # of the batch (other commits' events) nor mask
+                            # the committing caller's CRUD exception — the
+                            # same contract informers get from a real
+                            # apiserver watch (log and keep streaming)
+                            from karpenter_tpu import logging as klog
+
+                            klog.root.named("kube.watch").error(
+                                "watch subscriber failed",
+                                event=event,
+                                kind=kind,
+                                error=f"{type(e).__name__}: {e}",
+                            )
+            finally:
+                with self._lock:
+                    self._emitting = False
 
     # -- helpers ----------------------------------------------------------
 
@@ -119,17 +162,20 @@ class SimKube:
     # -- CRUD -------------------------------------------------------------
 
     def create(self, kind: str, obj):
-        with self._lock:
-            store = self._store(kind)
-            name = self._name(obj)
-            if name in store:
-                raise AlreadyExists(f"{kind}/{name}")
-            obj = copy.deepcopy(obj)
-            if getattr(obj, "metadata", None) is not None:
-                obj.metadata.resource_version = next(self._version)
-            store[name] = obj
-            self._emit(ADDED, kind, copy.deepcopy(obj))
-            return copy.deepcopy(obj)
+        try:
+            with self._lock:
+                store = self._store(kind)
+                name = self._name(obj)
+                if name in store:
+                    raise AlreadyExists(f"{kind}/{name}")
+                obj = copy.deepcopy(obj)
+                if getattr(obj, "metadata", None) is not None:
+                    obj.metadata.resource_version = next(self._version)
+                store[name] = obj
+                self._emit(ADDED, kind, copy.deepcopy(obj))
+                return copy.deepcopy(obj)
+        finally:
+            self._pump()
 
     def get(self, kind: str, name: str):
         with self._lock:
@@ -153,56 +199,65 @@ class SimKube:
     def update(self, kind: str, obj):
         """Optimistic-concurrency update; finalizer-clearing completes a
         pending delete."""
-        with self._lock:
-            store = self._store(kind)
-            name = self._name(obj)
-            current = store.get(name)
-            if current is None:
-                raise NotFound(f"{kind}/{name}")
-            if obj.metadata.resource_version != current.metadata.resource_version:
-                raise Conflict(
-                    f"{kind}/{name}: version {obj.metadata.resource_version} != "
-                    f"{current.metadata.resource_version}"
-                )
-            obj = copy.deepcopy(obj)
-            obj.metadata.resource_version = next(self._version)
-            if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
-                del store[name]
-                self._emit(DELETED, kind, copy.deepcopy(obj))
-                return None
-            store[name] = obj
-            self._emit(UPDATED, kind, copy.deepcopy(obj))
-            return copy.deepcopy(obj)
+        try:
+            with self._lock:
+                store = self._store(kind)
+                name = self._name(obj)
+                current = store.get(name)
+                if current is None:
+                    raise NotFound(f"{kind}/{name}")
+                if obj.metadata.resource_version != current.metadata.resource_version:
+                    raise Conflict(
+                        f"{kind}/{name}: version {obj.metadata.resource_version} != "
+                        f"{current.metadata.resource_version}"
+                    )
+                obj = copy.deepcopy(obj)
+                obj.metadata.resource_version = next(self._version)
+                if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+                    del store[name]
+                    self._emit(DELETED, kind, copy.deepcopy(obj))
+                    return None
+                store[name] = obj
+                self._emit(UPDATED, kind, copy.deepcopy(obj))
+                return copy.deepcopy(obj)
+        finally:
+            self._pump()
 
     def delete(self, kind: str, name: str, now: Optional[float] = None):
-        with self._lock:
-            store = self._store(kind)
-            current = store.get(name)
-            if current is None:
-                raise NotFound(f"{kind}/{name}")
-            if current.metadata.finalizers:
-                if current.metadata.deletion_timestamp is None:
-                    current.metadata.deletion_timestamp = (
-                        self.clock.now() if now is None else now
-                    )
-                    current.metadata.resource_version = next(self._version)
-                    self._emit(UPDATED, kind, copy.deepcopy(current))
+        try:
+            with self._lock:
+                store = self._store(kind)
+                current = store.get(name)
+                if current is None:
+                    raise NotFound(f"{kind}/{name}")
+                if current.metadata.finalizers:
+                    if current.metadata.deletion_timestamp is None:
+                        current.metadata.deletion_timestamp = (
+                            self.clock.now() if now is None else now
+                        )
+                        current.metadata.resource_version = next(self._version)
+                        self._emit(UPDATED, kind, copy.deepcopy(current))
+                    return None
+                del store[name]
+                self._emit(DELETED, kind, copy.deepcopy(current))
                 return None
-            del store[name]
-            self._emit(DELETED, kind, copy.deepcopy(current))
-            return None
+        finally:
+            self._pump()
 
     # -- typed conveniences ----------------------------------------------
 
     def bind(self, pod_name: str, node_name: str) -> None:
         """The kube-scheduler binding equivalent."""
-        with self._lock:
-            pod = self._store("Pod").get(pod_name)
-            if pod is None:
-                raise NotFound(f"Pod/{pod_name}")
-            pod.node_name = node_name
-            pod.metadata.resource_version = next(self._version)
-            self._emit(UPDATED, "Pod", copy.deepcopy(pod))
+        try:
+            with self._lock:
+                pod = self._store("Pod").get(pod_name)
+                if pod is None:
+                    raise NotFound(f"Pod/{pod_name}")
+                pod.node_name = node_name
+                pod.metadata.resource_version = next(self._version)
+                self._emit(UPDATED, "Pod", copy.deepcopy(pod))
+        finally:
+            self._pump()
 
     def pending_pods(self) -> list[Pod]:
         return self.list(

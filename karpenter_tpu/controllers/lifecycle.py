@@ -19,6 +19,7 @@ from karpenter_tpu.api.objects import (
     COND_LAUNCHED,
     COND_REGISTERED,
     NodeClaim,
+    Operator,
 )
 from karpenter_tpu.cloudprovider.types import CreateError, NodeClaimNotFoundError
 from karpenter_tpu.controllers.kube import Conflict, NotFound, SimKube
@@ -114,6 +115,16 @@ class NodeClaimLifecycle:
         claim.status.capacity = dict(launched.status.capacity)
         claim.status.allocatable = dict(launched.status.allocatable)
         claim.status.image_id = launched.status.image_id
+        # PopulateNodeClaimDetails (launch.go:126-140): cloud-resolved
+        # labels, then single-value requirement labels, then user-defined
+        # labels — later sources win. RequirementsDrifted diffs these
+        # labels against the nodepool's requirements (drift.go:168-174).
+        merged = dict(launched.metadata.labels)
+        for r in claim.requirements:
+            if r.operator == Operator.IN and len(r.values) == 1:
+                merged[r.key] = r.values[0]
+        merged.update(claim.metadata.labels)
+        claim.metadata.labels = merged
         claim.status.conditions[COND_LAUNCHED] = "True"
         self._update(claim)
         self.log.info(

@@ -111,29 +111,49 @@ class NodeClaimDisruptionConditions:
         return False
 
     def _drifted(self, claim: NodeClaim, np: NodePool) -> bool:
-        """drift.go:50: provider drift OR static-field hash drift."""
+        """drift.go:50 isDrifted: static-field hash drift, then
+        requirements drift, then the provider verdict (cheap checks first,
+        matching the reference's ordering to save provider calls)."""
         drifted = ""
-        provider_reason = self.cloud.is_drifted(claim)
-        if provider_reason:
-            drifted = provider_reason
-        else:
-            claim_hash = claim.metadata.annotations.get(
-                well_known.NODEPOOL_HASH_ANNOTATION_KEY
-            )
-            claim_ver = claim.metadata.annotations.get(
-                well_known.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
-            )
-            if (
-                claim_hash is not None
-                and claim_ver == NODEPOOL_HASH_VERSION
-                and claim_hash != nodepool_hash(np)
-            ):
-                drifted = "NodePoolDrifted"
+        claim_hash = claim.metadata.annotations.get(
+            well_known.NODEPOOL_HASH_ANNOTATION_KEY
+        )
+        claim_ver = claim.metadata.annotations.get(
+            well_known.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
+        )
+        if (
+            claim_hash is not None
+            and claim_ver == NODEPOOL_HASH_VERSION
+            and claim_hash != nodepool_hash(np)
+        ):
+            drifted = "NodePoolDrifted"
+        if not drifted:
+            drifted = self._requirements_drifted(claim, np)
+        if not drifted:
+            drifted = self.cloud.is_drifted(claim) or ""
         want = "True" if drifted else "False"
         if claim.status.conditions.get(COND_DRIFTED) != want:
             claim.status.conditions[COND_DRIFTED] = want
             return True
         return False
+
+    @staticmethod
+    def _requirements_drifted(claim: NodeClaim, np: NodePool) -> str:
+        """drift.go:168-174 areRequirementsDrifted: every nodepool template
+        requirement must be compatible with the claim's label set (the
+        labels PopulateNodeClaimDetails resolved at launch) — a nodepool
+        whose requirements changed out from under its nodes drifts them."""
+        from karpenter_tpu.scheduling import Requirements
+
+        if not claim.metadata.labels:
+            return ""  # not yet populated (pre-launch) — nothing to diff
+        pool_reqs = Requirements.from_node_selector_requirements(
+            np.template.requirements
+        )
+        claim_reqs = Requirements.from_labels(claim.metadata.labels)
+        if claim_reqs.compatible(pool_reqs) is not None:
+            return "RequirementsDrifted"
+        return ""
 
     def _empty(self, claim: NodeClaim) -> bool:
         if claim.status.conditions.get(COND_INITIALIZED) != "True":

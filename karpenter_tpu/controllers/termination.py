@@ -8,8 +8,11 @@ Reference /root/reference/pkg/controllers/node/termination/:
 Flow per reconcile of a deleting Node:
 1. ensure the disrupted NoSchedule taint,
 2. evict evictable pods in priority groups (PDB-gated), daemonsets last,
-3. once drained, delete the cloud instance and drop the finalizer
-   (the Node object then vanishes; the claim's finalizer completes next).
+3. once drained, await VolumeAttachment deletion (the external
+   attach-detach controller's job; skipped once terminationGracePeriod
+   elapses — controller.go:223-252),
+4. delete the cloud instance and drop the finalizer (the Node object then
+   vanishes; the claim's finalizer completes next).
 """
 
 from __future__ import annotations
@@ -154,7 +157,24 @@ class NodeTermination:
         nodepool = node.metadata.labels.get(well_known.NODEPOOL_LABEL_KEY, "")
         NODES_DRAINED.inc({"nodepool": nodepool})
 
-        # 3. instance deletion + finalizer removal (controller.go:269)
+        # 3. await volume detachment (controller.go:223-252): the external
+        # attach-detach controller deletes VolumeAttachments after unmount;
+        # instance deletion blocks until the node's attachments are gone —
+        # unless the claim's terminationGracePeriod has elapsed (force),
+        # matching hasTerminationGracePeriodElapsed's skip.
+        if not force:
+            pending = self._pending_volume_attachments(name)
+            if pending:
+                self.recorder.publish(
+                    Event(
+                        "Node", name, "Normal", "AwaitingVolumeDetachment",
+                        f"awaiting deletion of {len(pending)} volume "
+                        "attachment(s)",
+                    )
+                )
+                return "awaiting-volume-detachment"
+
+        # 4. instance deletion + finalizer removal (controller.go:269)
         if claim is not None:
             try:
                 self.cloud.delete(claim)
@@ -175,6 +195,22 @@ class NodeTermination:
         )
         self.log.info("terminated node", node=name, nodepool=nodepool)
         return "terminated"
+
+    def _pending_volume_attachments(self, node_name: str) -> list:
+        """controller.go:296 pendingVolumeAttachments: the node's
+        VolumeAttachments minus those belonging to non-drainable pods
+        (filterVolumeAttachments — pods termination won't evict keep their
+        volumes mounted forever; waiting on them would deadlock)."""
+        vas = self.kube.list(
+            "VolumeAttachment", lambda va: va.node_name == node_name
+        )
+        if not vas:
+            return []
+        undrainable_vols: set[str] = set()
+        for p in self.kube.list("Pod"):
+            if p.node_name == node_name and not is_evictable(p):
+                undrainable_vols.update(p.volume_claims)
+        return [va for va in vas if va.volume_name not in undrainable_vols]
 
     # -- eviction ---------------------------------------------------------
 

@@ -47,7 +47,8 @@ class Counter(Metric):
             self.values[k] = self.values.get(k, 0.0) + by
 
     def value(self, labels: Optional[dict] = None) -> float:
-        return self.values.get(self._key(labels or {}), 0.0)
+        with self._lock:
+            return self.values.get(self._key(labels or {}), 0.0)
 
 
 class Gauge(Metric):
@@ -57,7 +58,8 @@ class Gauge(Metric):
         self._lock = threading.Lock()
 
     def set(self, value: float, labels: Optional[dict] = None) -> None:
-        self.values[self._key(labels or {})] = value
+        with self._lock:
+            self.values[self._key(labels or {})] = value
 
     def add(self, by: float, labels: Optional[dict] = None) -> None:
         k = self._key(labels or {})
@@ -65,10 +67,12 @@ class Gauge(Metric):
             self.values[k] = self.values.get(k, 0.0) + by
 
     def value(self, labels: Optional[dict] = None) -> float:
-        return self.values.get(self._key(labels or {}), 0.0)
+        with self._lock:
+            return self.values.get(self._key(labels or {}), 0.0)
 
     def delete(self, labels: dict) -> None:
-        self.values.pop(self._key(labels), None)
+        with self._lock:
+            self.values.pop(self._key(labels), None)
 
 
 class Histogram(Metric):
@@ -92,10 +96,24 @@ class Histogram(Metric):
             self.totals[k] = self.totals.get(k, 0) + 1
 
     def count(self, labels: Optional[dict] = None) -> int:
-        return self.totals.get(self._key(labels or {}), 0)
+        with self._lock:
+            return self.totals.get(self._key(labels or {}), 0)
 
     def sum(self, labels: Optional[dict] = None) -> float:
-        return self.sums.get(self._key(labels or {}), 0.0)
+        with self._lock:
+            return self.sums.get(self._key(labels or {}), 0.0)
+
+    def snapshot(self) -> tuple[dict, dict, dict]:
+        """Consistent (counts, sums, totals) copy for exposition: a
+        /metrics scrape racing a worker-pool observe must not see a torn
+        histogram (bucket/sum/count mismatch) or a dict mutated during
+        iteration."""
+        with self._lock:
+            return (
+                {k: list(v) for k, v in self.counts.items()},
+                dict(self.sums),
+                dict(self.totals),
+            )
 
     @contextmanager
     def measure(self, labels: Optional[dict] = None):
@@ -171,17 +189,20 @@ class Registry:
                 return "{" + pairs + "}"
 
             if isinstance(m, Histogram):
-                for k, counts in m.counts.items():
+                counts_s, sums_s, totals_s = m.snapshot()
+                for k, counts in counts_s.items():
                     base = [f'{n}="{v}"' for n, v in zip(m.label_names, k)]
                     for b, c in zip(m.buckets, counts):
                         pairs = ",".join(base + [f'le="{b}"'])
                         lines.append(f"{m.name}_bucket{{{pairs}}} {c}")
                     inf_pairs = ",".join(base + ['le="+Inf"'])
-                    lines.append(f"{m.name}_bucket{{{inf_pairs}}} {m.totals[k]}")
-                    lines.append(f"{m.name}_sum{fmt(k)} {m.sums[k]}")
-                    lines.append(f"{m.name}_count{fmt(k)} {m.totals[k]}")
+                    lines.append(f"{m.name}_bucket{{{inf_pairs}}} {totals_s[k]}")
+                    lines.append(f"{m.name}_sum{fmt(k)} {sums_s[k]}")
+                    lines.append(f"{m.name}_count{fmt(k)} {totals_s[k]}")
             else:
-                for k, v in m.values.items():
+                with m._lock:
+                    values_s = dict(m.values)
+                for k, v in values_s.items():
                     lines.append(f"{m.name}{fmt(k)} {v}")
         return "\n".join(lines) + "\n"
 

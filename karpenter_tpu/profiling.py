@@ -90,6 +90,10 @@ def profile_cpu(seconds: float = 1.0, hz: float = 100.0) -> StackSampler:
 
 
 _tracemalloc_started = False
+# ThreadingHTTPServer can run two /debug/pprof/heap requests concurrently;
+# the start/snapshot/stop sequence must be atomic or one request can call
+# take_snapshot after the other stopped tracing (RuntimeError -> 500)
+_tracemalloc_lock = threading.Lock()
 
 
 def heap_snapshot(top: int = 30, keep_tracing: bool = False) -> str:
@@ -104,16 +108,17 @@ def heap_snapshot(top: int = 30, keep_tracing: bool = False) -> str:
     import tracemalloc
 
     global _tracemalloc_started
-    if not tracemalloc.is_tracing():
-        tracemalloc.start()
-        _tracemalloc_started = True
-    snap = tracemalloc.take_snapshot()
-    # stop tracing we own unless asked to keep it (so a keep_tracing call
-    # followed by a plain one turns it back off); tracing started by the
-    # application itself is left alone
-    if _tracemalloc_started and not keep_tracing:
-        tracemalloc.stop()
-        _tracemalloc_started = False
+    with _tracemalloc_lock:
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            _tracemalloc_started = True
+        snap = tracemalloc.take_snapshot()
+        # stop tracing we own unless asked to keep it (so a keep_tracing
+        # call followed by a plain one turns it back off); tracing started
+        # by the application itself is left alone
+        if _tracemalloc_started and not keep_tracing:
+            tracemalloc.stop()
+            _tracemalloc_started = False
     all_stats = snap.statistics("lineno")
     total = sum(s.size for s in all_stats)
     lines = [f"heap: {total} bytes traced (since profiling was enabled)"]

@@ -378,3 +378,127 @@ def test_termination_grace_period_force_drains_past_pdb():
         if op.kube.try_get("Node", node.name) is None:
             break
     assert op.kube.try_get("Node", node.name) is None, "forced drain completes"
+
+
+def test_termination_waits_for_volume_detachment():
+    """termination/controller.go:223-252: after drain, instance deletion
+    blocks until the node's VolumeAttachments are deleted (the external
+    attach-detach controller's job, simulated here); attachments owned by
+    non-drainable pods never block."""
+    from karpenter_tpu.api.objects import ObjectMeta, VolumeAttachment
+
+    op = small_operator()
+    fixtures.reset_rng(21)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Pod", fixtures.pod(name="w", requests={"cpu": "100m"}))
+    op.run_until_settled(max_ticks=30)
+    node = op.kube.list("Node")[0]
+    claim = op.kube.list("NodeClaim")[0]
+
+    op.kube.create(
+        "VolumeAttachment",
+        VolumeAttachment(
+            metadata=ObjectMeta(name="va-1"),
+            node_name=node.name,
+            volume_name="pvc-data",
+        ),
+    )
+    op.kube.delete("NodeClaim", claim.name)
+    for _ in range(10):
+        op.step(2.0)
+    # drained, but the node must still exist: the attachment is pending
+    assert op.kube.try_get("Node", node.name) is not None
+    assert claim.status.provider_id not in op.cloud.deleted
+    assert op.recorder.for_reason("AwaitingVolumeDetachment")
+
+    # the attach-detach controller finishes -> termination completes
+    op.kube.delete("VolumeAttachment", "va-1")
+    for _ in range(10):
+        op.step(2.0)
+    assert op.kube.try_get("Node", node.name) is None
+    assert claim.status.provider_id in op.cloud.deleted
+
+
+def test_termination_grace_period_skips_volume_wait():
+    """controller.go:257-263: once the claim's terminationGracePeriod
+    elapses, pending attachments stop blocking instance deletion."""
+    from karpenter_tpu.api.objects import ObjectMeta, VolumeAttachment
+
+    op = small_operator()
+    fixtures.reset_rng(22)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Pod", fixtures.pod(name="w", requests={"cpu": "100m"}))
+    op.run_until_settled(max_ticks=30)
+    node = op.kube.list("Node")[0]
+    claim = op.kube.get("NodeClaim", op.kube.list("NodeClaim")[0].name)
+    claim.termination_grace_period_seconds = 10.0
+    op.kube.update("NodeClaim", claim)
+
+    op.kube.create(
+        "VolumeAttachment",
+        VolumeAttachment(
+            metadata=ObjectMeta(name="va-stuck"),
+            node_name=node.name,
+            volume_name="pvc-stuck",
+        ),
+    )
+    op.kube.delete("NodeClaim", claim.name)
+    op.step(2.0)
+    assert op.kube.try_get("Node", node.name) is not None  # still waiting
+    op.clock.advance(12.0)  # past the grace period
+    for _ in range(10):
+        op.step(2.0)
+    assert op.kube.try_get("Node", node.name) is None
+    assert claim.status.provider_id in op.cloud.deleted
+
+
+def test_requirements_drift_marks_and_replaces_node():
+    """drift.go:168-174 areRequirementsDrifted: a nodepool whose
+    requirements change out from under its nodes drifts them — the claim's
+    labels (populated at launch, launch.go:126-140) no longer satisfy the
+    pool's requirements, the Drifted condition goes True, and the
+    disruption loop replaces the node."""
+    from karpenter_tpu.api import labels as well_known
+    from karpenter_tpu.api.objects import (
+        COND_DRIFTED,
+        NodeSelectorRequirement,
+        Operator,
+    )
+
+    op = small_operator()
+    fixtures.reset_rng(23)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(
+            name="default",
+            requirements=[
+                NodeSelectorRequirement(
+                    well_known.TOPOLOGY_ZONE_LABEL_KEY,
+                    Operator.IN,
+                    ["test-zone-a"],
+                )
+            ],
+        ),
+    )
+    op.kube.create("Pod", fixtures.pod(name="w", requests={"cpu": "100m"}))
+    op.run_until_settled(max_ticks=30)
+    claim = op.kube.list("NodeClaim")[0]
+    # launch populated the claim's labels from the resolved offering
+    assert claim.metadata.labels.get(well_known.TOPOLOGY_ZONE_LABEL_KEY) == "test-zone-a"
+    op.claim_conditions.reconcile_all()
+    assert op.kube.get("NodeClaim", claim.name).status.conditions.get(COND_DRIFTED) != "True"
+
+    # the pool's requirements move to zone-b: existing claim labels no
+    # longer satisfy them -> RequirementsDrifted
+    pool = op.kube.get("NodePool", "default")
+    pool.template.requirements = [
+        NodeSelectorRequirement(
+            well_known.TOPOLOGY_ZONE_LABEL_KEY, Operator.IN, ["test-zone-b"]
+        )
+    ]
+    op.kube.update("NodePool", pool)
+    op.claim_conditions.reconcile_all()
+    assert (
+        op.kube.get("NodeClaim", claim.name).status.conditions.get(COND_DRIFTED)
+        == "True"
+    )
