@@ -95,7 +95,10 @@ def simulate_scheduling(
         topology,
         views,
         daemonset_pods,
-        SchedulerOptions(timeout_seconds=opts.solve_timeout_seconds),
+        SchedulerOptions(
+            timeout_seconds=opts.solve_timeout_seconds,
+            tpu_min_pods=opts.tpu_min_pods,
+        ),
         force_oracle=force_oracle,
     )
     results = scheduler.solve(pods)

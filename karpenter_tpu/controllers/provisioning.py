@@ -329,6 +329,7 @@ class Provisioner:
                 reserved_capacity_enabled=self.opts.feature_gates.reserved_capacity,
                 timeout_seconds=self.opts.solve_timeout_seconds,
                 claim_slot_div=self.opts.tpu_claim_slot_div,
+                tpu_min_pods=self.opts.tpu_min_pods,
             ),
             force_oracle=self.force_oracle,
         )

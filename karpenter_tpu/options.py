@@ -59,6 +59,7 @@ class Options:
     min_values_policy: str = "Strict"  # Strict | BestEffort
     solve_timeout_seconds: float = 60.0  # provisioner.go:366
     tpu_claim_slot_div: int = 4  # SchedulerOptions.claim_slot_div
+    tpu_min_pods: int = 768  # SchedulerOptions.tpu_min_pods (0 disables routing)
     # disruption
     disruption_poll_seconds: float = 10.0  # disruption/controller.go:69
     multinode_consolidation_timeout_seconds: float = 60.0
@@ -107,6 +108,7 @@ class Options:
         f("KARPENTER_PROBE_PORT", int, "probe_port")
         f("KARPENTER_TERMINATION_WORKERS", int, "termination_workers")
         f("KARPENTER_TPU_CLAIM_SLOT_DIV", int, "tpu_claim_slot_div")
+        f("KARPENTER_TPU_MIN_PODS", int, "tpu_min_pods")
         f("KARPENTER_LEADER_ELECT_LEASE_PATH", str, "leader_elect_lease_path")
         f("KARPENTER_LEADER_ELECT_LEASE_SECONDS", float, "leader_elect_lease_seconds")
         f("KARPENTER_LEADER_ELECT_RENEW_SECONDS", float, "leader_elect_renew_seconds")

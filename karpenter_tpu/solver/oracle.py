@@ -173,6 +173,14 @@ class SchedulerOptions:
     # bucketed, grows on kernel overflow). Smaller pools cut per-step
     # candidate screens; too small forces an overflow re-solve.
     claim_slot_div: int = 4
+    # Hybrid routing: batches below this size with NO topology groups run
+    # on the oracle — the device launch/tunnel floor (~0.7s) beats the
+    # oracle only above the crossover. Measured on the tunneled v5e
+    # (requests-only mix, 50 types): oracle 1006 pods/s vs TPU 556 at 500
+    # pods; TPU wins from ~1k. Topology-bearing problems skip the check:
+    # the oracle's domain tracking collapses its throughput (~150 pods/s
+    # at 250 diverse pods — TPU already 2x ahead there). 0 disables.
+    tpu_min_pods: int = 768
 
 
 @dataclass

@@ -199,6 +199,7 @@ def _slice_decode_state(st, n2: int, ecols: int):
                 st.ereq,
                 st.v_cnt,
                 st.h_cnt[:, :ecols],
+                st.trem,
             )
 
         _slice_decode_cached = jax.jit(impl, static_argnames=("n2", "ecols"))
@@ -270,6 +271,7 @@ def _dedup_decode_state(st, n2: int, ecols: int):
                 st.ereq,
                 st.v_cnt,
                 st.h_cnt[:, :ecols],
+                st.trem,
             )
             return small, compact
 
@@ -768,7 +770,8 @@ class TpuScheduler:
             # unique count amortizes against MBs of duplicate rows)
             small, compact = _dedup_decode_state(st, n2=n2, ecols=E + n2)
             (
-                n_uniq, inv, crequests, tmpl, eavail, ereq_t, v_cnt, h_cnt
+                n_uniq, inv, crequests, tmpl, eavail, ereq_t, v_cnt, h_cnt,
+                trem,
             ) = jax.device_get(small)
             n_uniq = int(n_uniq)
             u2 = min(_pow2(max(n_uniq, 1), floor=64), n2)
@@ -803,7 +806,8 @@ class TpuScheduler:
             alive = np.ascontiguousarray(alive_u[inv])
         else:
             (
-                creq, crequests, alive, tmpl, eavail, ereq_t, v_cnt, h_cnt
+                creq, crequests, alive, tmpl, eavail, ereq_t, v_cnt, h_cnt,
+                trem,
             ) = jax.device_get(_slice_decode_state(st, n2=n2, ecols=E + n2))
             creq = Reqs(*(np.asarray(a) for a in creq))
             alive = np.asarray(alive)
@@ -891,6 +895,20 @@ class TpuScheduler:
                 )
             )
             node.requirements = reqs
+
+        # sync nodepool-limit spend back to the host (scheduler.go:831
+        # subtractMax semantics live on device in st.trem) so a partitioned
+        # oracle continuation and later control-plane reads see the kernel's
+        # spend — without this, hybrid partitioning double-spends limits
+        trem = np.asarray(trem)
+        for t, nct in enumerate(scheduler.templates):
+            if not p.thas_limits[t]:
+                continue
+            rem = {}
+            for name, ri in table.index.items():
+                if p.tlimit_def[t, ri]:
+                    rem[name] = int(trem[t, ri]) * table.scale[ri]
+            scheduler.remaining_resources[nct.nodepool_name] = rem
 
         pod_errors: dict[str, str] = {}
         for i, pod in enumerate(p.pods):

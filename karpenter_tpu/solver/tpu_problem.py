@@ -177,31 +177,41 @@ def _gate(cond: bool, why: str) -> None:
         raise UnsupportedBySolver(why)
 
 
-def pod_unsupported_reason(pod: Pod) -> Optional[str]:
+def pod_unsupported_reason(
+    pod: Pod, ignore_preferences: bool = False
+) -> Optional[str]:
     """Why the kernel can't encode this pod (None = fully supported). The
     relaxation ladder (preferences.go:38) is the big one: it mutates pod
     specs mid-solve, which would force host round-trips per relaxation.
     The hybrid dispatch partitions per pod on this predicate — one
-    relaxable pod no longer drags a whole batch to the oracle."""
+    relaxable pod no longer drags a whole batch to the oracle.
+
+    Under PreferencePolicy=Ignore (scheduler.go:74-85) preferences are not
+    relaxed — they are DROPPED up front (strict requirements, soft TSCs
+    untracked), so none of the relaxation gates apply and the kernel
+    encodes the strict problem directly."""
     if pod.host_ports:
         return "pod host ports"
     if pod.volume_claims:
         return "pod volume claims"
-    if pod.pod_affinity_preferred:
-        return "preferred pod affinity (relaxable)"
-    if pod.pod_anti_affinity_preferred:
-        return "preferred pod anti-affinity (relaxable)"
     na = pod.node_affinity
-    if na is not None:
-        if na.preferred:
+    if na is not None and len(na.required_terms) > 1:
+        # OR-terms are REQUIREMENTS, not preferences: the ladder moves to
+        # the next term on failure even under PreferencePolicy=Ignore
+        # (preferences.go:43 runs for required terms regardless of policy)
+        return "multiple required node-affinity terms (relaxable)"
+    if not ignore_preferences:
+        if pod.pod_affinity_preferred:
+            return "preferred pod affinity (relaxable)"
+        if pod.pod_anti_affinity_preferred:
+            return "preferred pod anti-affinity (relaxable)"
+        if na is not None and na.preferred:
             return "preferred node affinity (relaxable)"
-        if len(na.required_terms) > 1:
-            return "multiple required node-affinity terms (relaxable)"
-    if any(
-        t.when_unsatisfiable != "DoNotSchedule"
-        for t in pod.topology_spread_constraints
-    ):
-        return "ScheduleAnyway topology spread (relaxable)"
+        if any(
+            t.when_unsatisfiable != "DoNotSchedule"
+            for t in pod.topology_spread_constraints
+        ):
+            return "ScheduleAnyway topology spread (relaxable)"
     if well_known.HOSTNAME_LABEL_KEY in pod.node_selector:
         return "hostname node selector"
     if na is not None:
@@ -212,15 +222,14 @@ def pod_unsupported_reason(pod: Pod) -> Optional[str]:
     return None
 
 
-def _check_pod_supported(pod: Pod) -> None:
-    reason = pod_unsupported_reason(pod)
+def _check_pod_supported(pod: Pod, ignore_preferences: bool = False) -> None:
+    reason = pod_unsupported_reason(pod, ignore_preferences)
     _gate(reason is not None, reason or "")
 
 
 def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
     """Build the full tensor problem from an oracle Scheduler + pod batch."""
     _gate(scheduler.opts.reserved_capacity_enabled, "reserved capacity")
-    _gate(scheduler.opts.ignore_preferences, "PreferencePolicy=Ignore")  # TODO
 
     # the oracle handles the all-types-filtered-out case with per-pod errors
     # (scheduler.go:489); zero templates would also give zero-width tensors
@@ -253,7 +262,8 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
     class_reqs = _class_pass(p, scheduler, pods)
     for c, i in enumerate(p.class_reps):
         pod = pods[i]
-        _check_pod_supported(pod)  # every gated field is a class field
+        # every gated field is a class field
+        _check_pod_supported(pod, scheduler.opts.ignore_preferences)
         for r in class_reqs[c].values():
             if r.key != well_known.HOSTNAME_LABEL_KEY:
                 vocab.observe_requirement(r)
@@ -656,10 +666,17 @@ def _class_pass(
             p.pinv_h_c[c, inv_start + k] = inv_rows[c][k] if inv_rows[c] else False
             p.pown_h_c[c, inv_start + k] = own_rows[c][k] if own_rows[c] else False
 
-    # per-class Requirements, shared by vocab observation and encode
+    # per-class Requirements, shared by vocab observation and encode.
+    # PreferencePolicy=Ignore drops preferred terms up front
+    # (scheduler.go:74-85; strict_from_pod keeps required_terms[0] only)
+    from_pod = (
+        Requirements.strict_from_pod
+        if scheduler.opts.ignore_preferences
+        else Requirements.from_pod
+    )
     class_reqs: list[Requirements] = []
     for i in reps:
-        reqs = Requirements.from_pod(pods[i])
+        reqs = from_pod(pods[i])
         reqs.pop(well_known.HOSTNAME_LABEL_KEY)
         class_reqs.append(reqs)
     return class_reqs

@@ -88,10 +88,14 @@ def test_host_ports_partition_to_oracle():
     """A host-ports pod rides the oracle continuation while the rest of the
     batch stays on the kernel (per-pod partitioning; whole-batch fallback
     was the round-2 cliff)."""
+    from karpenter_tpu.solver.oracle import SchedulerOptions
+
     fixtures.reset_rng(7)
     pods = fixtures.make_generic_pods(4)
     pods[2].host_ports = [("", "TCP", 8080)]
-    h = HybridScheduler(*_problem(pods))
+    # tpu_min_pods=0: this test pins the PARTITIONING behavior, not the
+    # size-based routing (which would send 4 topology-free pods oracle-ward)
+    h = HybridScheduler(*_problem(pods), options=SchedulerOptions(tpu_min_pods=0))
     results = h.solve(pods)
     assert h.used_tpu is True
     assert "host ports" in h.fallback_reason
@@ -244,3 +248,78 @@ def test_continuation_sees_claim_hostname_counts_with_padded_existing_slots():
         assert any(
             tg.domains.get(hn) == want_count for tg in hostname_groups
         ), (hn, want_count, [dict(tg.domains) for tg in hostname_groups])
+
+
+def test_small_topology_free_batch_routes_to_oracle():
+    """Size-based routing: below the measured crossover a topology-free
+    batch runs on the oracle (a 500-pod production tick must never be
+    slowed down by the device launch floor). Topology-bearing batches of
+    the same size still ride the kernel — the oracle's domain tracking is
+    the slow side there."""
+    fixtures.reset_rng(7)
+    pods = fixtures.make_generic_pods(12)  # no topology constraints
+    h = HybridScheduler(*_problem(pods))
+    r = h.solve(pods)
+    assert h.used_tpu is False
+    assert "crossover" in (h.fallback_reason or "")
+    assert not r.pod_errors
+
+    # same size, but with a topology spread -> kernel path
+    fixtures.reset_rng(7)
+    pods = fixtures.make_topology_spread_pods(12, well_known.TOPOLOGY_ZONE_LABEL_KEY)
+    h = HybridScheduler(*_problem(pods))
+    r = h.solve(pods)
+    assert h.used_tpu is True, h.fallback_reason
+    assert not r.pod_errors
+
+    # tpu_min_pods=0 disables routing entirely
+    from karpenter_tpu.solver.oracle import SchedulerOptions
+
+    fixtures.reset_rng(7)
+    pods = fixtures.make_generic_pods(12)
+    pools, ibp, topo = _problem(pods)
+    h = HybridScheduler(pools, ibp, topo, options=SchedulerOptions(tpu_min_pods=0))
+    r = h.solve(pods)
+    assert h.used_tpu is True, h.fallback_reason
+    assert not r.pod_errors
+
+
+def test_partition_with_nodepool_limits_matches_oracle():
+    """Round-4: nodepool-limit spend syncs back from the device after
+    decode (tpu.py _decode -> oracle.remaining_resources), so the hybrid
+    can partition a mixed batch even with limits set — the continuation
+    must not double-spend the pool's budget. Result must equal the pure
+    oracle solve of the same problem."""
+    from karpenter_tpu.solver.oracle import SchedulerOptions
+
+    def build():
+        fixtures.reset_rng(13)
+        its = _universe()
+        pool = fixtures.node_pool(name="default", limits={"cpu": "24"})
+        pods = fixtures.make_generic_pods(12)
+        # one relaxable pod forces the partitioned continuation
+        pods += fixtures.make_preference_pods(1)
+        topo = Topology([pool], {"default": its}, pods)
+        return pool, its, topo, pods
+
+    outs = []
+    for force in (True, False):
+        pool, its, topo, pods = build()
+        h = HybridScheduler(
+            [pool], {"default": its}, topo,
+            options=SchedulerOptions(tpu_min_pods=0),
+            force_oracle=force,
+        )
+        outs.append((h.solve(pods), pods, h))
+    (orc, orc_pods, _), (hyb, hyb_pods, hs) = outs
+    assert hs.used_tpu is True, hs.fallback_reason
+    assert "continued on the oracle" in (hs.fallback_reason or "")
+    orc_names = {p.uid: p.name for p in orc_pods}
+    hyb_names = {p.uid: p.name for p in hyb_pods}
+    assert {orc_names[u] for u in orc.pod_errors} == {
+        hyb_names[u] for u in hyb.pod_errors
+    }
+    parts = lambda r: sorted(
+        tuple(sorted(p.name for p in c.pods)) for c in r.new_node_claims if c.pods
+    )
+    assert parts(orc) == parts(hyb)

@@ -45,7 +45,16 @@ def solve_both(pods_fn, pools_fn=None):
         pools = pools_fn() if pools_fn else [fixtures.node_pool(name="default")]
         pods = pods_fn()
         topo = Topology(pools, {np.name: its for np in pools}, pods)
-        s = cls(pools, {np.name: its for np in pools}, topo)
+        # tpu_min_pods=0: the matrix pins KERNEL semantics on tiny batches;
+        # production size-routing would shunt them to the oracle
+        from karpenter_tpu.solver.oracle import SchedulerOptions
+
+        s = cls(
+            pools,
+            {np.name: its for np in pools},
+            topo,
+            options=SchedulerOptions(tpu_min_pods=0),
+        )
         outs.append((s.solve(pods), pods, s))
     (orc, orc_pods, _), (hyb, hyb_pods, hs) = outs
     orc_names = {p.uid: p.name for p in orc_pods}
@@ -263,8 +272,9 @@ def test_preference_mix_all_schedule(n):
 
 def test_ignore_preferences_policy_matches_oracle():
     """PreferencePolicy=Ignore (scheduler.go:74): preferences are stripped
-    up front; the tensor encoding gates this policy, so the hybrid must
-    fall back to the oracle wholesale and match it."""
+    up front — no relaxation ladder exists, so the kernel encodes the
+    strict problem DIRECTLY (round-4: the former PreferencePolicy=Ignore
+    encode gate is gone) and must match the oracle bit-for-bit."""
     from karpenter_tpu.solver.oracle import SchedulerOptions
 
     results = []
@@ -276,13 +286,60 @@ def test_ignore_preferences_policy_matches_oracle():
         topo = Topology([pool], {"default": its}, pods, ignore_preferences=True)
         s = cls(
             [pool], {"default": its}, topo,
-            options=SchedulerOptions(ignore_preferences=True),
+            options=SchedulerOptions(ignore_preferences=True, tpu_min_pods=0),
         )
         results.append((s.solve(pods), s))
     (orc, _), (hyb, hs) = results
     assert not orc.pod_errors and not hyb.pod_errors
-    assert hs.used_tpu is False  # the encode gates PreferencePolicy=Ignore
+    assert hs.used_tpu is True, hs.fallback_reason  # Ignore rides the kernel
     parts = lambda r: sorted(
         tuple(sorted(p.name for p in c.pods)) for c in r.new_node_claims if c.pods
     )
     assert parts(orc) == parts(hyb)
+
+
+def test_ignore_preferences_multiple_required_terms_matches_oracle():
+    """Under Ignore, multiple required node-affinity OR-terms never relax:
+    only term[0] applies (strict_from_pod) — kernel and oracle must agree,
+    including the pod erroring when term[0] is unsatisfiable."""
+    from karpenter_tpu.solver.oracle import SchedulerOptions
+
+    def build():
+        fixtures.reset_rng(19)
+        its = construct_instance_types(sizes=[2, 8])
+        pool = fixtures.node_pool(name="default")
+        pods = base_pods()
+        p = fixtures.pod(name="multi-term", requests={"cpu": "100m"})
+        p.node_affinity = NodeAffinity(
+            required_terms=[
+                NodeSelectorTerm(
+                    match_expressions=[
+                        NodeSelectorRequirement(ZONE, Operator.IN, ["no-such-zone"])
+                    ]
+                ),
+                NodeSelectorTerm(
+                    match_expressions=[
+                        NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-b"])
+                    ]
+                ),
+            ]
+        )
+        pods.append(p)
+        topo = Topology([pool], {"default": its}, pods, ignore_preferences=True)
+        return pool, its, topo, pods
+
+    outs = []
+    for cls in (Scheduler, HybridScheduler):
+        pool, its, topo, pods = build()
+        s = cls(
+            [pool], {"default": its}, topo,
+            options=SchedulerOptions(ignore_preferences=True, tpu_min_pods=0),
+        )
+        outs.append((s.solve(pods), s))
+    (orc, _), (hyb, hs) = outs
+    # OR-terms still relax under Ignore (they are requirements, not
+    # preferences): the pod rides the oracle continuation and lands via
+    # term[1]; the base pods ride the kernel
+    assert hs.used_tpu is True, hs.fallback_reason
+    assert "continued on the oracle" in (hs.fallback_reason or "")
+    assert not orc.pod_errors and not hyb.pod_errors

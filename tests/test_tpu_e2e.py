@@ -16,11 +16,16 @@ from karpenter_tpu.controllers.disruption.helpers import (
 )
 from karpenter_tpu.controllers.kube import DaemonSet, FakeClock
 from karpenter_tpu.controllers.operator import Operator
+from karpenter_tpu.options import Options
 from karpenter_tpu.testing import fixtures
 
 
 def tpu_operator():
-    op = Operator(clock=FakeClock(), force_oracle=False)
+    # tpu_min_pods=0: these tests pin the KERNEL path on deliberately
+    # tiny problems; production routing would send them to the oracle
+    op = Operator(
+        clock=FakeClock(), force_oracle=False, options=Options(tpu_min_pods=0)
+    )
     op.raw_cloud.types = construct_instance_types(sizes=[2, 8])
     op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
     fixtures.reset_rng(33)
