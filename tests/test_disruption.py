@@ -1030,3 +1030,77 @@ def test_consolidation_deletes_when_capacity_remains():
     assert cmds, "an underutilized multi-node cluster must yield a command"
     assert cmds[0].decision == DECISION_DELETE
     assert not cmds[0].replacements
+
+
+def test_fast_sweep_partial_feasibility_agrees_with_fallbacks():
+    """The delta-state sweep kernel (sweep.py _fast_sweep_kernel) must pick
+    the same feasibility vector as the vmapped full-state scan AND the same
+    largest prefix as the oracle binary search on a fleet where only a
+    strict prefix is consolidation-feasible (big riders exhaust the
+    keepers' free capacity plus one new claim)."""
+    import karpenter_tpu.controllers.disruption.sweep as sweep_mod
+    from karpenter_tpu.api.objects import Budget
+    from karpenter_tpu.controllers.disruption.consolidation import (
+        MultiNodeConsolidation,
+    )
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.operator import Operator
+
+    op = Operator(clock=FakeClock(), force_oracle=False)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="default", budgets=[Budget(nodes="100%")]),
+    )
+    fixtures.reset_rng(11)
+    fixtures.make_underutilized_fleet(
+        op,
+        10,
+        rider_requests={"cpu": "1200m", "memory": "256Mi"},
+        seed_requests={"cpu": "1500m", "memory": "512Mi"},
+    )
+    op.clock.advance(30.0)
+    op.pod_events.reconcile_all()
+    op.claim_conditions.reconcile_all()
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    mnc = MultiNodeConsolidation(*args, options=op.opts, force_oracle=True)
+    candidates = mnc.candidates()[:10]
+    assert len(candidates) == 10
+
+    calls = {"fast": 0}
+    orig = sweep_mod._fast_prefix_feasibility
+
+    def spy(*a, **k):
+        r = orig(*a, **k)
+        if r is not None:
+            calls["fast"] += 1
+        return r
+
+    sweep_mod._fast_prefix_feasibility = spy
+    try:
+        fast = sweep_mod.prefix_feasibility(
+            op.kube, op.cluster, op.cloud, candidates, op.opts
+        )
+        assert calls["fast"] == 1, "gates must admit the fast path here"
+        # force the vmapped full-state fallback on the same problem
+        sweep_mod._fast_prefix_feasibility = lambda *a, **k: None
+        slow = sweep_mod.prefix_feasibility(
+            op.kube, op.cluster, op.cloud, candidates, op.opts
+        )
+    finally:
+        sweep_mod._fast_prefix_feasibility = orig
+    assert fast == slow, (fast, slow)
+
+    # ground truth: per-prefix oracle simulation (the sweep's feasibility
+    # contract is SCHEDULABILITY with <= 1 new claim; price/spot rules are
+    # applied afterwards by compute_consolidation, not by the sweep)
+    from karpenter_tpu.controllers.disruption.helpers import simulate_scheduling
+
+    want = []
+    for k in range(len(candidates)):
+        sim = simulate_scheduling(
+            op.kube, op.cluster, op.cloud, candidates[: k + 1], op.opts,
+            force_oracle=True,
+        )
+        claims = [c for c in sim.results.new_node_claims if c.pods]
+        want.append(sim.all_pods_scheduled() and len(claims) <= 1)
+    assert fast == want, (fast, want)
