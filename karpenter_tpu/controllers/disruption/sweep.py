@@ -342,8 +342,7 @@ def prefix_feasibility(
         key=lambda i: ffd_sort_key(pods[i], data[pods[i].uid].requests),
     )
 
-    tb = sched._tables(problem)
-    sched._typeok = sched._pod_typeok(problem, tb)
+    tb = sched._tables(problem)  # also sets sched._typeok
     sched._upload_pod_tables(problem)
     # a consolidation-feasible prefix opens at most 1 new claim; a prefix
     # that overflows even a handful of slots is infeasible anyway
@@ -479,6 +478,7 @@ def prefix_feasibility(
         prequests=None, typeok=None, tol_t=None, tol_e=None,
         topo_kind=None, topo_gid=None, topo_sel=None,
         sel_v=None, sel_h=None, inv_h=None, own_h=None, valid=0,
+        rrow=None, ntiers=None,
     )
     st_b = base._replace(
         eavail=jnp.asarray(eavail_b),

@@ -15,8 +15,9 @@ while routing the computation:
   reads it. Callers therefore never see UnsupportedBySolver.
 
 The fallback taxonomy (what routes to the oracle) is documented in
-tpu_problem._check_pod_supported: preference relaxation, host ports, volume
-claims, hostname selectors, reserved capacity.
+tpu_problem.pod_unsupported_reason: host ports, volume claims, hostname
+requirements, over-long relaxation ladders — plus the whole-problem gates
+(reserved capacity). Preference relaxation rides the kernel since round 4.
 """
 
 from __future__ import annotations

@@ -97,10 +97,24 @@ class Preferences:
             self._remove_tsc_schedule_anyway,
         ):
             if fn(pod):
+                self._invalidate_class_caches(pod)
                 return True
         if self.tolerate_prefer_no_schedule and self._tolerate_prefer_no_schedule(pod):
+            self._invalidate_class_caches(pod)
             return True
         return False
+
+    @staticmethod
+    def _invalidate_class_caches(pod: Pod) -> None:
+        """Relaxation changes every decision-relevant field the memoized
+        class key covers (solver/ordering.py); deep copies inherit the
+        cached attributes, so a mutated pod must drop them or the encoder
+        would dedup it into its pre-relaxation class."""
+        for attr in ("_ktpu_class_key", "_ktpu_class_repr", "_ktpu_class_sig"):
+            try:
+                delattr(pod, attr)
+            except AttributeError:
+                pass
 
     @staticmethod
     def _remove_required_node_affinity_term(pod: Pod) -> bool:

@@ -10,10 +10,14 @@ Fidelity contract: for supported problems the per-pod decisions (which
 existing node / in-flight claim / new template, in first-fit order) are
 bit-identical to the oracle — tests/test_tpu_parity.py enforces this against
 randomized problem mixes, including the reference benchmark's diverse pod
-classes (scheduling_benchmark_test.go:257 makeDiversePods). Unsupported
-features (preference relaxation, host ports, reserved capacity, hostname
-selectors, exotic topology filters) raise UnsupportedBySolver at encode
-time; Solver.solve() then falls back to the oracle — the hybrid dispatch.
+classes (scheduling_benchmark_test.go:257 makeDiversePods). Preference
+relaxation rides the kernel (round 4): the ladder's tiers are encoded per
+requirement class and a pod's step attempts them in order
+(tpu_kernel._step_relax — scheduler.go:434 trySchedule's inline
+relax-on-a-copy). Unsupported features (host ports, volume claims,
+reserved capacity, hostname requirements, exotic topology filters) raise
+UnsupportedBySolver at encode time; Solver.solve() then falls back to the
+oracle — the hybrid dispatch.
 
 The queue progress loop (scheduler.go:380 "schedule again if progress was
 made") maps to outer rounds: failed pods are re-submitted against the
@@ -112,7 +116,7 @@ def _gather_xs(tables, idx, n):
                 preq_r, typeok_r, tol_t_r, tol_e_r,
                 kind_r, gid_r, tsel_r, rcls_of,
                 prequests_c, cls, srow, sel_rows_v, sel_rows_h,
-                inv_c, own_c,
+                inv_c, own_c, ntiers_r, rrow_of,
             ) = tables
             idx = idx.astype(jnp.int32)
             ci = cls[idx].astype(jnp.int32)
@@ -133,6 +137,8 @@ def _gather_xs(tables, idx, n):
                 inv_h=inv_c[ci],
                 own_h=own_c[ci],
                 valid=valid,
+                rrow=rrow_of[ri],
+                ntiers=ntiers_r[ri],
             )
 
         _gather_xs_cached = jax.jit(impl)
@@ -352,7 +358,10 @@ def _bulk_class_flags(p: EncodedProblem, gates_ok: bool) -> np.ndarray:
     if not gates_ok:
         return np.zeros(NC, bool)
     dyn_v = np.isin(p.ptopo_kind_c, (TOPO_SPREAD_V, TOPO_ANTI_V)) & p.ptopo_sel_c
-    return ~dyn_v.any(axis=1)
+    # relaxable classes run the exact per-pod step (the tier loop lives
+    # there); bulk phases assume a run of single-tier identical deciders
+    ntiers_c = p.ntiers_r[p.rcls_of]
+    return ~dyn_v.any(axis=1) & (ntiers_c == 1)
 
 
 
@@ -425,8 +434,7 @@ class TpuScheduler:
         from karpenter_tpu.solver import tpu_runs as KR
 
         with prof.phase("upload"):
-            tb = self._tables(problem)
-            self._typeok = self._pod_typeok(problem, tb)
+            tb = self._tables(problem)  # also sets self._typeok
             self._upload_pod_tables(problem)
         gates_ok = _bulk_gates(problem)
         self._bulk_flags_c = _bulk_class_flags(problem, gates_ok)
@@ -573,6 +581,24 @@ class TpuScheduler:
             return jnp.zeros((0, IW), jnp.uint32)
         return chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
 
+    def _tier_typeok(self, p: EncodedProblem, tb):
+        """[NRx, L, IW] u32 — per relaxable rclass and tier, the pairwise
+        pod-vs-type screen (the tier analog of _pod_typeok)."""
+        import jax.numpy as jnp
+
+        I = p.num_types
+        IW = max(1, (I + 31) // 32)
+        if not p.rt_tier_reqs:
+            return jnp.zeros((1, 1, IW), jnp.uint32)
+        NRx = len(p.rt_tier_reqs)
+        L = p.num_tiers
+        flat = Reqs(*(a.reshape((NRx * L,) + a.shape[2:]) for a in p.rt_preq))
+        pad_to = _pow2(NRx * L)
+        idx = np.arange(pad_to) % (NRx * L)
+        chunk = Reqs(*(jnp.asarray(a[idx]) for a in flat))
+        rows = _typeok_chunk(tb.ireq, tb.va, chunk, iw=IW)[: NRx * L]
+        return rows.reshape(NRx, L, IW)
+
     # -- tensor construction --------------------------------------------
 
     def _tables(self, p: EncodedProblem):
@@ -605,7 +631,7 @@ class TpuScheduler:
                 )
             )
 
-        return K.Tables(
+        tb = K.Tables(
             va=va,
             treq=jreq(p.treq),
             tdaemon=jnp.asarray(p.tdaemon),
@@ -630,7 +656,19 @@ class TpuScheduler:
             h_filt=pad_group_v(p.h_filt, fill=-1),
             h_inverse=pad_group_v(h_inverse, fill=False),
             filter_reqs=pad_reqs_rows(p.filter_reqs),
+            rt_preq=jreq(p.rt_preq),
+            rt_typeok=jnp.zeros(
+                (1, 1, max(1, (p.num_types + 31) // 32)), jnp.uint32
+            ),
+            rt_tol_t=jnp.asarray(p.rt_tol_t),
+            rt_tol_e=jnp.asarray(p.rt_tol_e),
+            rt_kind=jnp.asarray(p.rt_kind),
+            rt_gid=jnp.asarray(p.rt_gid),
+            rt_sel=jnp.asarray(p.rt_sel),
         )
+        # tier type-screens need tb.ireq/va: fill after base construction
+        self._typeok = self._pod_typeok(p, tb)
+        return tb._replace(rt_typeok=self._tier_typeok(p, tb))
 
     def _init_state(self, p: EncodedProblem, N: int):
         import jax.numpy as jnp
@@ -712,6 +750,8 @@ class TpuScheduler:
             jnp.asarray(pad_g(p.sel_rows_h, Gh)),
             jnp.asarray(pad_g(p.pinv_h_c, Gh)),
             jnp.asarray(pad_g(p.pown_h_c, Gh)),
+            jnp.asarray(p.ntiers_r),
+            jnp.asarray(p.rrow_of_rcls),
         )
         from karpenter_tpu.solver.tpu_problem import (
             TOPO_AFFINITY_H,

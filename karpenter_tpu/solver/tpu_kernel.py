@@ -95,6 +95,18 @@ class Tables(NamedTuple):
     h_inverse: jax.Array  # [Gh] bool
     # node filters [F]
     filter_reqs: Reqs
+    # relaxation-tier tables per requirement class [NR, L, ...]
+    # (preferences.go:38 ladder, precomputed host-side: tier 0 = the pod
+    # as submitted, tier t = after t relax rungs; a pod's step attempts
+    # tiers in order WITHIN its own evaluation — scheduler.go:434
+    # trySchedule relaxes inline on a copy before other pods interleave)
+    rt_preq: Reqs  # [NR, L, ...]
+    rt_typeok: jax.Array  # [NR, L, IW] u32
+    rt_tol_t: jax.Array  # [NR, L, T] bool
+    rt_tol_e: jax.Array  # [NR, L, E] bool
+    rt_kind: jax.Array  # [NR, L, C] i32
+    rt_gid: jax.Array  # [NR, L, C] i32
+    rt_sel: jax.Array  # [NR, L, C] bool
 
 
 class State(NamedTuple):
@@ -136,6 +148,10 @@ class PodX(NamedTuple):
     inv_h: jax.Array  # [Gh]
     own_h: jax.Array  # [Gh]
     valid: jax.Array  # scalar bool
+    # relaxation: this pod's row into Tables.rt_* (only meaningful when
+    # ntiers > 1) and how many ladder tiers it has (1 = nothing to relax)
+    rrow: jax.Array  # scalar i32
+    ntiers: jax.Array  # scalar i32
 
 
 def _row(r: Reqs, i) -> Reqs:
@@ -717,11 +733,58 @@ def _step(tb: Tables, st: State, x: PodX):
     return new_state, (kind, out_slot, overflow)
 
 
+def _x_at_tier(tb: Tables, x: PodX, t) -> PodX:
+    """The pod's PodX with tier-t requirement-class rows substituted
+    (requests, selection, inverse rows are tier-independent)."""
+    ri = x.rrow
+    return x._replace(
+        preq=Reqs(*(a[ri, t] for a in tb.rt_preq)),
+        typeok=tb.rt_typeok[ri, t],
+        tol_t=tb.rt_tol_t[ri, t],
+        tol_e=tb.rt_tol_e[ri, t],
+        topo_kind=tb.rt_kind[ri, t],
+        topo_gid=tb.rt_gid[ri, t],
+        topo_sel=tb.rt_sel[ri, t],
+    )
+
+
+def _step_relax(tb: Tables, st: State, x: PodX):
+    """scheduler.go:434 trySchedule: a pod attempts its relaxation tiers
+    IN ORDER within its own step (the reference relaxes inline on a copy
+    until the pod schedules or the ladder is exhausted — no other pod
+    interleaves between tiers). Single-tier pods take the plain _step
+    through lax.cond, so problems without relaxable classes pay nothing
+    beyond the branch."""
+
+    def plain(_):
+        return _step(tb, st, x)
+
+    def tiers(_):
+        def cond(c):
+            t, done, _, _ = c
+            return (~done) & (t < x.ntiers)
+
+        def body(c):
+            t, _, _, _ = c
+            st2, out = _step(tb, st, _x_at_tier(tb, x, t))
+            kind, _, over = out
+            done = (kind != KIND_FAIL) | over | ~x.valid
+            return (t + 1, done, st2, out)
+
+        dummy = (jnp.int32(KIND_FAIL), jnp.int32(-1), jnp.zeros((), bool))
+        _, _, st2, out = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.zeros((), bool), st, dummy)
+        )
+        return st2, out
+
+    return jax.lax.cond(x.ntiers > 1, tiers, plain, None)
+
+
 @functools.partial(jax.jit, static_argnames=())
 def solve_scan(tb: Tables, st: State, xs: PodX):
     """Run the greedy pack over a pod batch; returns
     (state, kinds, slots, overflowed) — overflowed means some pod failed
     only because claim slots ran out (host should grow N and re-solve)."""
-    step = functools.partial(_step, tb)
+    step = functools.partial(_step_relax, tb)
     st, (kinds, slots, overflow) = jax.lax.scan(step, st, xs)
     return st, kinds, slots, jnp.any(overflow)

@@ -164,6 +164,21 @@ class EncodedProblem:
     sel_rows_v: Optional[np.ndarray] = None  # [U, Gv] bool
     sel_rows_h: Optional[np.ndarray] = None  # [U, Gh] bool
 
+    # relaxation tiers (preferences.go:38 ladder, walked host-side per
+    # requirement class; a pod's kernel step attempts tiers in order —
+    # tpu_kernel._step_relax). Tier tables are stored only for RELAXABLE
+    # rclasses (rrow_of_rcls maps into them); L = num_tiers.
+    num_tiers: int = 1
+    ntiers_r: Optional[np.ndarray] = None  # [NR] i32
+    rrow_of_rcls: Optional[np.ndarray] = None  # [NR] i32 (0 when not relaxable)
+    rt_tier_reqs: list = field(default_factory=list)  # [NRx][L] Requirements
+    rt_preq: Optional[Reqs] = None  # [NRx, L, ...]
+    rt_tol_t: Optional[np.ndarray] = None  # [NRx, L, T]
+    rt_tol_e: Optional[np.ndarray] = None  # [NRx, L, E]
+    rt_kind: Optional[np.ndarray] = None  # [NRx, L, C]
+    rt_gid: Optional[np.ndarray] = None  # [NRx, L, C]
+    rt_sel: Optional[np.ndarray] = None  # [NRx, L, C]
+
 
 def _pow2(n: int, floor: int = 8) -> int:
     out = floor
@@ -177,54 +192,102 @@ def _gate(cond: bool, why: str) -> None:
         raise UnsupportedBySolver(why)
 
 
+MAX_RELAX_TIERS = 12
+
+
 def pod_unsupported_reason(
     pod: Pod, ignore_preferences: bool = False
 ) -> Optional[str]:
-    """Why the kernel can't encode this pod (None = fully supported). The
-    relaxation ladder (preferences.go:38) is the big one: it mutates pod
-    specs mid-solve, which would force host round-trips per relaxation.
-    The hybrid dispatch partitions per pod on this predicate — one
-    relaxable pod no longer drags a whole batch to the oracle.
+    """Why the kernel can't encode this pod (None = fully supported).
 
-    Under PreferencePolicy=Ignore (scheduler.go:74-85) preferences are not
-    relaxed — they are DROPPED up front (strict requirements, soft TSCs
-    untracked), so none of the relaxation gates apply and the kernel
-    encodes the strict problem directly."""
+    Round 4: the relaxation ladder (preferences.go:38) rides the kernel —
+    tiers are precomputed per requirement class at encode time and a pod's
+    step attempts them in order (tpu_kernel._step_relax mirrors
+    scheduler.go:434 trySchedule's inline relax-on-a-copy), so preferred
+    affinities, ScheduleAnyway TSCs, and required OR-terms are no longer
+    fallback reasons. What remains gated: host ports, volume claims,
+    hostname requirements (a node IS its hostname slot — no vocab id), and
+    pathologically long ladders."""
     if pod.host_ports:
         return "pod host ports"
     if pod.volume_claims:
         return "pod volume claims"
-    na = pod.node_affinity
-    if na is not None and len(na.required_terms) > 1:
-        # OR-terms are REQUIREMENTS, not preferences: the ladder moves to
-        # the next term on failure even under PreferencePolicy=Ignore
-        # (preferences.go:43 runs for required terms regardless of policy)
-        return "multiple required node-affinity terms (relaxable)"
-    if not ignore_preferences:
-        if pod.pod_affinity_preferred:
-            return "preferred pod affinity (relaxable)"
-        if pod.pod_anti_affinity_preferred:
-            return "preferred pod anti-affinity (relaxable)"
-        if na is not None and na.preferred:
-            return "preferred node affinity (relaxable)"
-        if any(
-            t.when_unsatisfiable != "DoNotSchedule"
-            for t in pod.topology_spread_constraints
-        ):
-            return "ScheduleAnyway topology spread (relaxable)"
     if well_known.HOSTNAME_LABEL_KEY in pod.node_selector:
         return "hostname node selector"
+    na = pod.node_affinity
+    rungs = 0
     if na is not None:
         for term in na.required_terms:
             for e in term.match_expressions:
                 if e.key == well_known.HOSTNAME_LABEL_KEY:
                     return "hostname affinity term"
+        for w in na.preferred:
+            for e in w.preference.match_expressions:
+                if e.key == well_known.HOSTNAME_LABEL_KEY:
+                    return "hostname preferred-affinity term"
+        rungs += max(0, len(na.required_terms) - 1)
+        if not ignore_preferences:
+            rungs += len(na.preferred)
+    if not ignore_preferences:
+        # under Ignore, preference rungs don't change the strict problem —
+        # the ladder walk collapses them to zero effective tiers
+        rungs += len(pod.pod_affinity_preferred)
+        rungs += len(pod.pod_anti_affinity_preferred)
+        rungs += sum(
+            1
+            for t in pod.topology_spread_constraints
+            if t.when_unsatisfiable != "DoNotSchedule"
+        )
+    if rungs + 2 > MAX_RELAX_TIERS:  # +1 tier 0, +1 PreferNoSchedule rung
+        return "relaxation ladder too long"
     return None
 
 
 def _check_pod_supported(pod: Pod, ignore_preferences: bool = False) -> None:
     reason = pod_unsupported_reason(pod, ignore_preferences)
     _gate(reason is not None, reason or "")
+
+
+def _tier_key(pod: Pod, ignore_preferences: bool):
+    """The EFFECTIVE constraint signature of a tier. Under Respect this is
+    the full class key; under PreferencePolicy=Ignore only strict
+    requirements and tolerations matter (preferences are dropped up front,
+    so rungs that strip them are no-ops and must collapse)."""
+    from karpenter_tpu.solver.ordering import pod_class_key
+
+    if not ignore_preferences:
+        return pod_class_key(pod)
+    reqs = Requirements.strict_from_pod(pod)
+    return (
+        tuple(
+            sorted(
+                (r.key, str(r.operator()), tuple(sorted(r.values)), r.complement)
+                for r in reqs.values()
+            )
+        ),
+        tuple((t.key, t.operator, t.value, t.effect) for t in pod.tolerations),
+    )
+
+
+def _walk_ladder(scheduler, pod: Pod) -> list[Pod]:
+    """Tier pod copies, tier 0 first: the oracle's own Preferences walks
+    the rungs (preferences.go:38 order cannot drift between paths).
+    Consecutive tiers with equal EFFECTIVE constraints collapse — an
+    attempt with identical constraints against the same state returns the
+    same verdict, so the duplicate rung is a no-op (this is what keeps
+    PreferencePolicy=Ignore ladders short: preference rungs don't change
+    the strict problem)."""
+    ignore = scheduler.opts.ignore_preferences
+    tiers = [pod.deep_copy()]
+    keys = [_tier_key(tiers[0], ignore)]
+    copy = pod.deep_copy()
+    while scheduler.preferences.relax(copy):  # relax invalidates key caches
+        k = _tier_key(copy, ignore)
+        if k != keys[-1]:
+            tiers.append(copy.deep_copy())
+            keys.append(k)
+        _gate(len(tiers) > MAX_RELAX_TIERS, "relaxation ladder too long")
+    return tiers
 
 
 def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
@@ -269,6 +332,34 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
                 vocab.observe_requirement(r)
         table.observe(pod.requests)
     table.observe({res.PODS: 1000})
+
+    # ---- relaxation ladders (per requirement class) --------------------
+    # tier requirements must be in the vocab BEFORE finalize; the tier
+    # TABLES are built later (_encode_pod_classes) once group ids exist
+    from_pod_fn = (
+        Requirements.strict_from_pod
+        if scheduler.opts.ignore_preferences
+        else Requirements.from_pod
+    )
+    ladders: list[Optional[list]] = []  # per rclass: None or [(pod, reqs)]
+    for rid, c0 in enumerate(p.rclass_creps):
+        rep = pods[p.class_reps[c0]]
+        tiers = _walk_ladder(scheduler, rep)
+        if len(tiers) == 1:
+            ladders.append(None)
+            continue
+        tier_rows = []
+        for tp in tiers:
+            reqs = from_pod_fn(tp)
+            _gate(
+                reqs.has(well_known.HOSTNAME_LABEL_KEY),
+                "hostname requirement on a relaxation tier",
+            )
+            for r in reqs.values():
+                vocab.observe_requirement(r)
+            tier_rows.append((tp, reqs))
+        ladders.append(tier_rows)
+    p._ladders = ladders
     for node in scheduler.existing_nodes:
         vocab.observe_labels(node.view.labels)
         table.observe(node.remaining_resources)
@@ -768,3 +859,81 @@ def _encode_pod_classes(
             p.ptopo_gid_c[c, slot] = gid
             p.ptopo_sel_c[c, slot] = vrow[gid] if fam == "v" else hrow[gid]
             slot += 1
+
+    # ---- relaxation tier tables (per relaxable requirement class) ------
+    # tier 0 = the pod as submitted; tier t = after t effective relax
+    # rungs (encode_problem walked the ladder pre-finalize and observed
+    # every tier's requirement values). Tiers repeat their last row up to
+    # L — the kernel's tier loop stops at ntiers, padding is unreachable.
+    ladders = getattr(p, "_ladders", [])
+    NR = len(p.rclass_creps)
+    p.ntiers_r = np.ones(NR, np.int32)
+    p.rrow_of_rcls = np.zeros(NR, np.int32)
+    relax_rows: list[tuple[int, list]] = []
+    for rid, ladder in enumerate(ladders):
+        if ladder is None:
+            continue
+        p.ntiers_r[rid] = len(ladder)
+        p.rrow_of_rcls[rid] = len(relax_rows)
+        relax_rows.append((rid, ladder))
+    NRx = len(relax_rows)
+    L = max((len(ladder) for _, ladder in relax_rows), default=1)
+    p.num_tiers = L
+    if NRx:
+        # inverse-anti rows are tier-INDEPENDENT by construction: inverse
+        # group OWNERSHIP comes from required anti terms only
+        # (topology.py _update_inverse_anti_affinity — required anti never
+        # relaxes), and inverse SELECTION is label-based — so the class
+        # rows pinv_h_c/pown_h_c stay correct at every tier
+        p.rt_tol_t = np.zeros((NRx, L, T), bool)
+        p.rt_tol_e = np.zeros((NRx, L, E), bool)
+        p.rt_kind = np.zeros((NRx, L, C), np.int32)
+        p.rt_gid = np.zeros((NRx, L, C), np.int32)
+        p.rt_sel = np.zeros((NRx, L, C), bool)
+        reqs_flat: list[Requirements] = []
+        for x_i, (rid, ladder) in enumerate(relax_rows):
+            rep_i = reps[p.rclass_creps[rid]]
+            s = int(p.srow[rep_i])
+            vrow, hrow = p.sel_rows_v[s], p.sel_rows_h[s]
+            tier_reqs = []
+            for t_i in range(L):
+                tp, reqs = ladder[min(t_i, len(ladder) - 1)]
+                tier_reqs.append(reqs)
+                reqs_flat.append(reqs)
+                for t, nct in enumerate(scheduler.templates):
+                    p.rt_tol_t[x_i, t_i, t] = tolerates(nct.taints, tp)
+                for e, node in enumerate(scheduler.existing_nodes):
+                    p.rt_tol_e[x_i, t_i, e] = tolerates(node.cached_taints, tp)
+                groups = topo._new_for_topologies(tp) + topo._new_for_affinities(tp)
+                _gate(len(groups) > C, "tier owns too many topology constraints")
+                slot = 0
+                for tg_new in groups:
+                    tg = topo.topology_groups.get(tg_new.hash_key())
+                    if tg is None or id(tg) not in group_vid:
+                        raise UnsupportedBySolver(
+                            "relaxation tier topology group missing from encode"
+                        )
+                    fam, gid = group_vid[id(tg)]
+                    p.rt_kind[x_i, t_i, slot] = kind_of[(fam, tg.type)]
+                    p.rt_gid[x_i, t_i, slot] = gid
+                    p.rt_sel[x_i, t_i, slot] = (
+                        vrow[gid] if fam == "v" else hrow[gid]
+                    )
+                    slot += 1
+            p.rt_tier_reqs.append(tier_reqs)
+        try:
+            flat = encode_requirements(vocab, reqs_flat)
+        except UnsupportedProblem as e:
+            raise UnsupportedBySolver(str(e)) from e
+        p.rt_preq = Reqs(
+            *(a.reshape((NRx, L) + a.shape[1:]) for a in flat)
+        )
+    else:
+        # uniform shapes for Tables even with nothing to relax; the tier
+        # branch is unreachable (every pod has ntiers == 1)
+        p.rt_preq = empty_reqs(vocab, (1, 1))
+        p.rt_tol_t = np.zeros((1, 1, T), bool)
+        p.rt_tol_e = np.zeros((1, 1, E), bool)
+        p.rt_kind = np.zeros((1, 1, C), np.int32)
+        p.rt_gid = np.zeros((1, 1, C), np.int32)
+        p.rt_sel = np.zeros((1, 1, C), bool)

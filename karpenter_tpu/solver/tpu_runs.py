@@ -346,7 +346,7 @@ def solve_runs(tb: Tables, st: State, rx: RunX, seq, next_seq, n_valid):
         # only ever uses rank for min-selection (its rank updates are
         # discarded here), so the key substitutes directly — no sort
         st_in = st._replace(rank=_seq_key(st.count, seq, st.active))
-        st2, (kind, slot, oflow) = K._step(tb, st_in, x)
+        st2, (kind, slot, oflow) = K._step_relax(tb, st_in, x)
         joined = kind == KIND_CLAIM
         created = kind == KIND_NEW
         upd = joined | created

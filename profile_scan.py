@@ -33,7 +33,6 @@ def main():
 
     N = args.slots or _pow2(len(pods))
     tb = sched._tables(problem)
-    sched._typeok = sched._pod_typeok(problem, tb)
     st = sched._init_state(problem, N)
     xs = sched._pod_xs(problem, list(range(len(pods))))
     print(

@@ -45,21 +45,48 @@ def test_supported_problem_uses_tpu_and_matches_oracle():
 
 def test_unsupported_problem_falls_back_without_raising():
     fixtures.reset_rng(7)
-    # preferred node affinity is on the relaxation ladder -> unsupported by
-    # the tensor encoding (tpu_problem._check_pod_supported)
-    pods = fixtures.make_preference_pods(8)
-    h = HybridScheduler(*_problem(pods))
+    # host-ports pods stay outside the tensor encoding
+    # (tpu_problem._check_pod_supported); a batch of ONLY unsupported pods
+    # falls back wholesale without raising
+    from karpenter_tpu.solver.oracle import SchedulerOptions
+
+    pods = fixtures.make_generic_pods(8)
+    for i, p in enumerate(pods):
+        p.host_ports = [("", "TCP", 9000 + i)]
+    # tpu_min_pods=0 so the UNSUPPORTED fallback (not size routing) is
+    # what this test exercises
+    h = HybridScheduler(*_problem(pods), options=SchedulerOptions(tpu_min_pods=0))
     results = h.solve(pods)  # must not raise
     assert h.used_tpu is False
     assert h.fallback_reason is not None
-    assert "relaxable" in h.fallback_reason
+    assert "host ports" in h.fallback_reason
     assert not results.pod_errors
 
     # and the fallback result equals a pure-oracle run of the same problem
     fixtures.reset_rng(7)
-    pods2 = fixtures.make_preference_pods(8)
+    pods2 = fixtures.make_generic_pods(8)
+    for i, p in enumerate(pods2):
+        p.host_ports = [("", "TCP", 9000 + i)]
     want = Scheduler(*_problem(pods2)).solve(pods2)
     assert results.node_pod_counts() == want.node_pod_counts()
+
+
+def test_preference_pods_ride_the_kernel():
+    """Round 4: the relaxation ladder lives in the kernel step
+    (tpu_kernel._step_relax); preference pods no longer fall back, and the
+    outcome matches the oracle's relax-until-schedulable semantics."""
+    fixtures.reset_rng(7)
+    pods = fixtures.make_preference_pods(8)
+    h = HybridScheduler(*_problem(pods))
+    results = h.solve(pods)
+    assert h.used_tpu is True, h.fallback_reason
+    assert h.fallback_reason is None
+    assert not results.pod_errors
+
+    fixtures.reset_rng(7)
+    pods2 = fixtures.make_preference_pods(8)
+    want = Scheduler(*_problem(pods2)).solve(pods2)
+    assert sorted(results.node_pod_counts()) == sorted(want.node_pod_counts())
 
 
 def test_tpu_path_raises_only_inside_dispatch():
@@ -68,7 +95,8 @@ def test_tpu_path_raises_only_inside_dispatch():
     from karpenter_tpu.solver.tpu import TpuScheduler
 
     fixtures.reset_rng(7)
-    pods = fixtures.make_preference_pods(4)
+    pods = fixtures.make_generic_pods(4)
+    pods[1].node_selector = {well_known.HOSTNAME_LABEL_KEY: "some-node"}
     t = TpuScheduler(*_problem(pods))
     with pytest.raises(UnsupportedBySolver):
         t.solve(pods)
@@ -136,15 +164,21 @@ def test_mixed_batch_partitions_per_pod():
             )
         ],
     )
+    # a host-ports pod still partitions; the former relaxable partition
+    # case now rides the kernel's tier ladder (asserted separately below)
+    ported = fixtures.pod(name="ported", requests={"cpu": "100m"})
+    ported.host_ports = [("", "TCP", 8080)]
     pods.append(relaxable)
+    pods.append(ported)
     topo = Topology([pool], {"default": its}, pods)
     s = HybridScheduler([pool], {"default": its}, topo)
     r = s.solve(pods)
     assert s.used_tpu is True, s.fallback_reason
     assert s.fallback_reason and "continued on the oracle" in s.fallback_reason
+    assert "host ports" in s.fallback_reason
     assert not r.pod_errors, r.pod_errors
     placed = {p.name for c in r.new_node_claims for p in c.pods}
-    assert "anyway" in placed
+    assert "anyway" in placed and "ported" in placed
     assert len(placed) == len(pods)
 
 
@@ -297,8 +331,10 @@ def test_partition_with_nodepool_limits_matches_oracle():
         its = _universe()
         pool = fixtures.node_pool(name="default", limits={"cpu": "24"})
         pods = fixtures.make_generic_pods(12)
-        # one relaxable pod forces the partitioned continuation
-        pods += fixtures.make_preference_pods(1)
+        # one host-ports pod forces the partitioned continuation
+        hp = fixtures.pod(name="hp", requests={"cpu": "100m"})
+        hp.host_ports = [("", "TCP", 8080)]
+        pods.append(hp)
         topo = Topology([pool], {"default": its}, pods)
         return pool, its, topo, pods
 

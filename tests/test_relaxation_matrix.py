@@ -163,8 +163,10 @@ def test_unsatisfiable_preferred_pod_affinity_drops(anti):
 
     orc, hyb, hs = solve_both(pods)
     assert not orc.pod_errors and not hyb.pod_errors
+    # round 4: relaxable preferences ride the kernel (tier ladder inside
+    # the step) — no oracle continuation
     assert hs.used_tpu is True
-    assert hs.fallback_reason and "continued on the oracle" in hs.fallback_reason
+    assert hs.fallback_reason is None, hs.fallback_reason
 
 
 def test_weighted_preferences_drop_highest_first():
@@ -338,8 +340,24 @@ def test_ignore_preferences_multiple_required_terms_matches_oracle():
         outs.append((s.solve(pods), s))
     (orc, _), (hyb, hs) = outs
     # OR-terms still relax under Ignore (they are requirements, not
-    # preferences): the pod rides the oracle continuation and lands via
-    # term[1]; the base pods ride the kernel
+    # preferences): the kernel's tier ladder lands the pod via term[1]
     assert hs.used_tpu is True, hs.fallback_reason
-    assert "continued on the oracle" in (hs.fallback_reason or "")
+    assert not orc.pod_errors and not hyb.pod_errors
+
+
+def test_preference_pods_under_inverse_anti_affinity_match_oracle():
+    """The c6 shape in miniature: required-anti pods (app=nginx) register
+    INVERSE groups whose selector also matches the preference pods
+    (app=nginx with preferred anti + node preference). Inverse rows are
+    tier-independent (ownership = required anti only; selection = labels),
+    so the kernel's tier ladder must still match the oracle exactly."""
+
+    def pods():
+        out = fixtures.make_pod_anti_affinity_pods(6, HOSTNAME)
+        out += fixtures.make_preference_pods(4)
+        return out
+
+    orc, hyb, hs = solve_both(pods)
+    assert hs.used_tpu is True, hs.fallback_reason
+    assert hs.fallback_reason is None, hs.fallback_reason
     assert not orc.pod_errors and not hyb.pod_errors

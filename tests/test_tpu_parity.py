@@ -339,15 +339,26 @@ def test_fallback_when_no_templates_survive():
         t.solve(pods)
 
 
-def test_fallback_on_preferences():
-    fixtures.reset_rng(42)
-    its = fake_types(10)
-    np_ = fixtures.node_pool(name="default")
-    pods = fixtures.make_preference_pods(5)
-    topo = Topology([np_], {"default": its}, pods)
-    t = TpuScheduler([np_], {"default": its}, topo)
-    with pytest.raises(UnsupportedBySolver):
-        t.solve(pods)
+def test_preference_pods_match_oracle_on_kernel():
+    """Round 4: preference pods ride the kernel (tier ladder in the step,
+    tpu_kernel._step_relax mirrors scheduler.go:434 trySchedule — relax
+    all the way per ATTEMPT on a copy, retry from tier 0 next round) and
+    must make BIT-IDENTICAL decisions (CLAUDE.md parity invariant)."""
+    assert_parity(
+        run_both(kwok_problem(8, maker=fixtures.make_preference_pods))
+    )
+
+
+def test_preference_mix_matches_oracle_on_kernel():
+    """Diverse pods + a relaxable tail in ONE kernel solve — the c6 bench
+    shape in miniature, per-pod decision parity."""
+
+    def mix(n):
+        pods = fixtures.make_diverse_pods(n - 4)
+        pods += fixtures.make_preference_pods(4)
+        return pods
+
+    assert_parity(run_both(kwok_problem(40, maker=mix)))
 
 
 def test_adaptive_slots_overflow_retry():
