@@ -993,15 +993,34 @@ class TpuScheduler:
         )
 
     def _error_for(self, pod: Pod) -> str:
-        """Reconstruct a template-level failure message host-side
-        (nodeclaim.go:296 semantics). Topology-caused failures get a generic
-        message — the batched solver doesn't track per-template reasons."""
+        """Reconstruct a template-level failure message host-side with the
+        oracle's exact wording (nodeclaim.go:296 semantics; oracle._add):
+        limits filter, then requirements compat (well-known labels may be
+        undefined, like SchedulingNodeClaim.can_add), then the instance
+        type filter. Topology-caused failures get a generic message — the
+        batched solver doesn't track per-template reasons.
+        tests/test_scheduling_families.py pins text parity per case."""
+        from karpenter_tpu.scheduling import ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+        from karpenter_tpu.solver.oracle import _filter_by_remaining_resources
+
         scheduler = self.oracle
         data = scheduler.cached_pod_data[pod.uid]
         errs = []
         for nct in scheduler.templates:
+            its = nct.instance_type_options
+            rem = scheduler.remaining_resources.get(nct.nodepool_name)
+            if rem is not None:
+                its = InstanceTypes(_filter_by_remaining_resources(its, rem))
+                if not its:
+                    errs.append(
+                        f"all available instance types exceed limits for "
+                        f"nodepool {nct.nodepool_name!r}"
+                    )
+                    continue
             requirements = Requirements(nct.requirements.values())
-            err = requirements.compatible(data.requirements)
+            err = requirements.compatible(
+                data.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+            )
             if err is not None:
                 errs.append(f"incompatible requirements, {err}")
                 continue
@@ -1010,7 +1029,7 @@ class TpuScheduler:
                 scheduler.daemon_overhead[nct], data.requests
             )
             _, _, ferr = filter_instance_types(
-                nct.instance_type_options,
+                its,
                 requirements,
                 data.requests,
                 scheduler.daemon_overhead[nct],
