@@ -1000,13 +1000,21 @@ class TpuScheduler:
         type filter. Topology-caused failures get a generic message — the
         batched solver doesn't track per-template reasons.
         tests/test_scheduling_families.py pins text parity per case."""
-        from karpenter_tpu.scheduling import ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+        from karpenter_tpu.scheduling import (
+            ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+            Taints,
+        )
         from karpenter_tpu.solver.oracle import _filter_by_remaining_resources
 
         scheduler = self.oracle
         data = scheduler.cached_pod_data[pod.uid]
         errs = []
         for nct in scheduler.templates:
+            # oracle._add order: limits filter, then can_add (taints ->
+            # compat -> type filter). The oracle REQUEUES failed pods, so
+            # the error it reports is the FINAL attempt's — evaluated
+            # against end-of-solve state, which is exactly what the synced
+            # remaining_resources reflect here.
             its = nct.instance_type_options
             rem = scheduler.remaining_resources.get(nct.nodepool_name)
             if rem is not None:
@@ -1017,6 +1025,10 @@ class TpuScheduler:
                         f"nodepool {nct.nodepool_name!r}"
                     )
                     continue
+            terr = Taints(nct.taints).tolerates_pod(pod)
+            if terr is not None:
+                errs.append(terr)
+                continue
             requirements = Requirements(nct.requirements.values())
             err = requirements.compatible(
                 data.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS

@@ -59,7 +59,7 @@ def solve(
         kw["force_oracle"] = False
         options = options or SchedulerOptions()
         options.tpu_min_pods = 0
-    s = cls(pools, ibp, topo, views, daemons, options)
+    s = cls(pools, ibp, topo, views, daemons, options, **kw)
     return s.solve(pods), s
 
 
@@ -638,6 +638,69 @@ def test_error_text_parity_between_paths(case):
     herr = _err_texts(hyb, hpods)
     assert set(oerr) == set(herr) == {"bad"}, (oerr, herr)
     assert oerr["bad"] == herr["bad"], (oerr["bad"], herr["bad"])
+
+
+def test_error_text_parity_failure_before_limit_exhaustion():
+    """Ordering probe: the failing pod (zone=mars) first ATTEMPTS before
+    later pods exhaust the cpu limit — but the oracle REQUEUES failures,
+    so the error it finally reports comes from the LAST attempt, against
+    end-of-solve state: the limits text. The kernel's reconstruction runs
+    at end-of-solve state and must produce the same message."""
+
+    def build():
+        fixtures.reset_rng(17)
+        pool = fixtures.node_pool(name="default", limits={"cpu": "4"})
+        pods = [
+            fixtures.pod(
+                name="bad",
+                requests={"cpu": "1800m"},
+                node_requirements=[
+                    NodeSelectorRequirement(ZONE, Operator.IN, ["mars"])
+                ],
+            ),
+            fixtures.pod(name="w1", requests={"cpu": "1500m"}),
+            fixtures.pod(name="w2", requests={"cpu": "1500m"}),
+        ]
+        return pool, pods
+
+    outs = []
+    for kernel in (False, True):
+        pool, pods = build()
+        r, s = solve(pods, pools=[pool], kernel=kernel, sizes=(2,))
+        outs.append((r, pods))
+    (orc, opods), (hyb, hpods) = outs
+    oerr = _err_texts(orc, opods)
+    herr = _err_texts(hyb, hpods)
+    assert set(oerr) == set(herr) == {"bad"}, (oerr, herr)
+    assert oerr["bad"] == herr["bad"], (oerr["bad"], herr["bad"])
+    assert "exceed limits" in herr["bad"], herr["bad"]
+
+
+def test_error_text_taint_failure_names_the_taint():
+    """A tolerationless pod against an all-tainted universe fails with the
+    oracle's tolerates_pod message on both paths (can_add checks taints
+    FIRST, nodeclaim.go:114)."""
+
+    def build():
+        fixtures.reset_rng(19)
+        pool = fixtures.node_pool(
+            name="tainted",
+            taints=[Taint(key="team", value="a", effect=TaintEffect.NO_SCHEDULE)],
+        )
+        pods = [fixtures.pod(name="bad", requests={"cpu": "100m"})]
+        return pool, pods
+
+    outs = []
+    for kernel in (False, True):
+        pool, pods = build()
+        r, s = solve(pods, pools=[pool], kernel=kernel, sizes=(2,))
+        outs.append((r, pods))
+    (orc, opods), (hyb, hpods) = outs
+    oerr = _err_texts(orc, opods)
+    herr = _err_texts(hyb, hpods)
+    assert set(oerr) == set(herr) == {"bad"}
+    assert oerr["bad"] == herr["bad"], (oerr["bad"], herr["bad"])
+    assert "team" in herr["bad"], herr["bad"]
 
 
 def test_error_text_taxonomy():
