@@ -235,6 +235,10 @@ class Pod:
     # PVC names used by the pod (volume topology injection; reference
     # volumetopology.go:51)
     volume_claims: list[str] = field(default_factory=list)
+    # claim name -> CSI driver (resolved from StorageClass.provisioner by
+    # VolumeTopology.inject, like the zone requirements); claims absent
+    # here count against the default "" bucket
+    volume_drivers: dict[str, str] = field(default_factory=dict)
     scheduling_gates: list[str] = field(default_factory=list)
     # Set by the eviction/termination machinery
     terminating: bool = False
@@ -288,6 +292,9 @@ class Node:
     unschedulable: bool = False
     # condition type -> status ("True"/"False"/"Unknown"), for repair policies
     conditions: dict[str, str] = field(default_factory=dict)
+    # CSINode allocatable equivalent: attachable-volume count per CSI
+    # driver (reference volumeusage.go:187); empty = no per-driver limits
+    csi_allocatable: dict[str, int] = field(default_factory=dict)
 
     @property
     def name(self) -> str:
@@ -416,6 +423,10 @@ class StorageClass:
     # zones from allowedTopologies (empty = no restriction)
     zones: list[str] = field(default_factory=list)
     volume_binding_mode: str = "WaitForFirstConsumer"
+    # CSI driver name (StorageClass.provisioner) — per-driver volume-limit
+    # accounting keys on it (reference volumeusage.go:187 reads CSINode
+    # allocatable per driver); "" = the default/unattributed bucket
+    provisioner: str = ""
 
     @property
     def name(self) -> str:

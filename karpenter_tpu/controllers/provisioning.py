@@ -103,9 +103,18 @@ class VolumeTopology:
     def inject(self, pod: Pod) -> None:
         requirements: list[NodeSelectorRequirement] = []
         for claim_name in pod.volume_claims:
-            req = self._requirement_for(pod, claim_name)
+            pvc = self.kube.try_get("PersistentVolumeClaim", claim_name)
+            if pvc is None:
+                continue
+            req = self._requirement_for(pvc)
             if req is not None:
                 requirements.append(req)
+            # resolve the claim's CSI driver for per-driver volume-limit
+            # accounting (volumeusage.go:187: pod -> PVC -> StorageClass
+            # provisioner), from the same PVC fetch as the zone resolution
+            driver = self.driver_for(pvc)
+            if driver:
+                pod.volume_drivers[claim_name] = driver
         if not requirements:
             return
         if pod.node_affinity is None:
@@ -117,13 +126,29 @@ class VolumeTopology:
         for term in pod.node_affinity.required_terms:
             term.match_expressions = list(term.match_expressions) + requirements
 
-    def _requirement_for(
-        self, pod: Pod, claim_name: str
-    ) -> Optional[NodeSelectorRequirement]:
-        try:
-            pvc = self.kube.get("PersistentVolumeClaim", claim_name)
-        except NotFound:
-            return None
+    def driver_for(self, pvc) -> str:
+        """The claim's CSI driver via StorageClass.provisioner ("" when
+        unresolvable). Also used by the cluster cache when it tallies
+        BOUND pods' volumes (state.py) — attribution must agree between
+        the solve-time inject and the bound-pod accounting or per-driver
+        budgets double-count into the default bucket."""
+        if not pvc.storage_class_name:
+            return ""
+        sc = self.kube.try_get("StorageClass", pvc.storage_class_name)
+        return sc.provisioner if sc is not None else ""
+
+    def resolve_drivers(self, pod: Pod) -> None:
+        """Fill pod.volume_drivers in place (claim -> CSI driver)."""
+        for claim_name in pod.volume_claims:
+            if claim_name in pod.volume_drivers:
+                continue
+            pvc = self.kube.try_get("PersistentVolumeClaim", claim_name)
+            if pvc is not None:
+                driver = self.driver_for(pvc)
+                if driver:
+                    pod.volume_drivers[claim_name] = driver
+
+    def _requirement_for(self, pvc) -> Optional[NodeSelectorRequirement]:
         zones: list[str] = []
         if pvc.volume_zones:
             zones = list(pvc.volume_zones)  # bound volume wins

@@ -217,6 +217,9 @@ class StateNode:
             hostname=self.labels().get(well_known.HOSTNAME_LABEL_KEY, self.name),
             host_port_usage=self.host_port_usage.copy(),
             volume_usage=self.volume_usage.copy(),
+            csi_allocatable=dict(self.node.csi_allocatable)
+            if self.node is not None
+            else {},
         )
 
 
@@ -317,6 +320,9 @@ class Cluster:
 
     def __init__(self, clock) -> None:
         self.clock = clock
+        # set by wire_informers: fills pod.volume_drivers from PVC ->
+        # StorageClass.provisioner (VolumeTopology.resolve_drivers)
+        self.volume_driver_resolver = None
         self.nodes: dict[str, StateNode] = {}  # provider id -> StateNode
         self.node_name_to_pid: dict[str, str] = {}
         self.claim_name_to_pid: dict[str, str] = {}
@@ -503,6 +509,11 @@ class Cluster:
         else:
             sn.pod_requests[pod.uid] = requests
         sn.host_port_usage.add(pod, get_host_ports(pod))
+        if pod.volume_claims and self.volume_driver_resolver is not None:
+            # attribute the bound pod's volumes to their CSI drivers the
+            # same way the provisioner's inject does — per-driver budgets
+            # must see existing usage in the right bucket
+            self.volume_driver_resolver(pod)
         sn.volume_usage.add(pod)
 
     def _unbind(self, uid: str, node_name: str) -> None:
@@ -623,6 +634,9 @@ def cluster_source(kube, cluster: "Cluster", exclude_nodes: frozenset = frozense
 def wire_informers(kube, cluster: Cluster) -> None:
     """Subscribe the cluster cache to SimKube watch events — the analog of
     the reference's five informer controllers (state/informer/*.go)."""
+    from karpenter_tpu.controllers.provisioning import VolumeTopology
+
+    cluster.volume_driver_resolver = VolumeTopology(kube).resolve_drivers
 
     def handler(event: str, kind: str, obj) -> None:
         deleted = event == "deleted"

@@ -494,6 +494,9 @@ class StateNodeView:
     hostname: str = ""
     host_port_usage: HostPortUsage = field(default_factory=HostPortUsage)
     volume_usage: VolumeUsage = field(default_factory=VolumeUsage)
+    # CSINode allocatable equivalent: attachable volumes per CSI driver
+    # (reference volumeusage.go:187); merged with the label-derived default
+    csi_allocatable: dict = field(default_factory=dict)
     # set by the scheduler when a pod is nominated to this node
     nominations: int = 0
 
@@ -527,7 +530,13 @@ class ExistingNode:
         )
         self.host_port_usage = view.host_port_usage.copy()
         self.volume_usage = view.volume_usage.copy()
-        self.volume_limit = volume_limit(view.labels)
+        # per-driver limits: CSINode allocatable wins per driver; the node
+        # label provides the default "" bucket (volumeusage.go:187)
+        limits = dict(view.csi_allocatable or {})
+        label_default = volume_limit(view.labels)
+        if label_default is not None:
+            limits.setdefault("", label_default)
+        self.volume_limits = limits or None
         topology.register(well_known.HOSTNAME_LABEL_KEY, view.hostname)
 
     @property
@@ -545,7 +554,7 @@ class ExistingNode:
         hp_err = self.host_port_usage.conflicts(pod, get_host_ports(pod))
         if hp_err is not None:
             return None, f"checking host port usage, {hp_err}"
-        vol_err = self.volume_usage.exceeds_limit(pod, self.volume_limit)
+        vol_err = self.volume_usage.exceeds_limit(pod, self.volume_limits)
         if vol_err is not None:
             return None, f"checking volume usage, {vol_err}"
         if not res.fits(pod_data.requests, self.remaining_resources):

@@ -155,8 +155,10 @@ def _encode_views(views) -> list[dict]:
                     for uid, ports in v.host_port_usage._by_pod.items()
                 },
                 "volumes": {
-                    uid: sorted(s) for uid, s in v.volume_usage._by_pod.items()
+                    uid: sorted([list(p) for p in s])
+                    for uid, s in v.volume_usage._by_pod.items()
                 },
+                "csi_allocatable": dict(getattr(v, "csi_allocatable", {}) or {}),
             }
         )
     return out
@@ -179,11 +181,16 @@ def _decode_views(data) -> Optional[list[StateNodeView]]:
             },
             initialized=d["initialized"],
             hostname=d["hostname"],
+            csi_allocatable={
+                k: int(v2) for k, v2 in d.get("csi_allocatable", {}).items()
+            },
         )
         for uid, ports in d.get("host_ports", {}).items():
             v.host_port_usage._by_pod[uid] = [tuple(p) for p in ports]
         for uid, vols in d.get("volumes", {}).items():
-            v.volume_usage._by_pod[uid] = set(vols)
+            v.volume_usage._by_pod[uid] = {
+                tuple(p) if isinstance(p, list) else ("", p) for p in vols
+            }
         out.append(v)
     return out
 

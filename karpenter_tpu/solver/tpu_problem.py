@@ -292,7 +292,31 @@ def _walk_ladder(scheduler, pod: Pod) -> list[Pod]:
 
 def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
     """Build the full tensor problem from an oracle Scheduler + pod batch."""
-    _gate(scheduler.opts.reserved_capacity_enabled, "reserved capacity")
+    if scheduler.opts.reserved_capacity_enabled:
+        # the feature gate alone doesn't change semantics — only actual
+        # reserved offerings do (reservationmanager.go:28: with no
+        # reservation-id offerings, Reserve/Release never fire and the
+        # price ordering is untouched). Clusters running with the flag on
+        # but no capacity reservations ride the kernel.
+        def is_reserved(o):
+            if o.requirements.has(well_known.RESERVATION_ID_LABEL_KEY):
+                return True
+            # capacity-type 'reserved' without a reservation-id hits the
+            # oracle's reserve path too (nodes.py _offerings_to_reserve
+            # keys on capacity type; strict mode can raise) — gate both
+            if o.requirements.has(well_known.CAPACITY_TYPE_LABEL_KEY):
+                r = o.requirements.get(well_known.CAPACITY_TYPE_LABEL_KEY)
+                if well_known.CAPACITY_TYPE_RESERVED in r.values:
+                    return True
+            return False
+
+        has_reserved = any(
+            is_reserved(o)
+            for nct in scheduler.templates
+            for it in nct.instance_type_options
+            for o in it.offerings
+        )
+        _gate(has_reserved, "reserved capacity offerings present")
 
     # the oracle handles the all-types-filtered-out case with per-pod errors
     # (scheduler.go:489); zero templates would also give zero-width tensors

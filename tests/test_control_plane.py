@@ -502,3 +502,89 @@ def test_requirements_drift_marks_and_replaces_node():
         op.kube.get("NodeClaim", claim.name).status.conditions.get(COND_DRIFTED)
         == "True"
     )
+
+
+def test_per_driver_csi_volume_limits():
+    """volumeusage.go:187: attachable-volume budgets are PER CSI DRIVER
+    (CSINode allocatable), not one per-node number — a node saturated on
+    driver A still accepts driver-B volumes, and vice versa."""
+    from karpenter_tpu.api.objects import (
+        ObjectMeta,
+        PersistentVolumeClaim,
+        StorageClass,
+    )
+    from karpenter_tpu.scheduling.volumeusage import VolumeUsage
+    from karpenter_tpu.solver.nodes import StateNodeView
+    from karpenter_tpu.solver.oracle import Scheduler
+    from karpenter_tpu.solver.topology import Topology
+
+    # unit: per-driver accounting
+    vu = VolumeUsage()
+    bound = fixtures.pod(name="bound")
+    bound.volume_claims = ["a1", "a2"]
+    bound.volume_drivers = {"a1": "ebs.csi", "a2": "ebs.csi"}
+    vu.add(bound)
+    ebs_pod = fixtures.pod(name="p1")
+    ebs_pod.volume_claims = ["a3"]
+    ebs_pod.volume_drivers = {"a3": "ebs.csi"}
+    efs_pod = fixtures.pod(name="p2")
+    efs_pod.volume_claims = ["b1"]
+    efs_pod.volume_drivers = {"b1": "efs.csi"}
+    limits = {"ebs.csi": 2, "efs.csi": 2}
+    assert vu.exceeds_limit(ebs_pod, limits) is not None  # 3 > 2 on ebs
+    assert vu.exceeds_limit(efs_pod, limits) is None  # efs bucket empty
+
+    # solver: an existing node with per-driver budgets blocks only the
+    # saturated driver's pods
+    its = construct_instance_types(sizes=[2, 8])
+    view = StateNodeView(
+        name="node-1",
+        labels={
+            well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a",
+            well_known.HOSTNAME_LABEL_KEY: "node-1",
+            well_known.INSTANCE_TYPE_LABEL_KEY: "c-2x-amd64-linux",
+            well_known.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+            well_known.OS_LABEL_KEY: "linux",
+            well_known.ARCH_LABEL_KEY: "amd64",
+            well_known.NODEPOOL_LABEL_KEY: "default",
+        },
+        available={"cpu": 1800, "memory": 3 * 1024**3 * 1000, "pods": 20000},
+        capacity={"cpu": 2000, "memory": 4 * 1024**3 * 1000},
+        initialized=True,
+        csi_allocatable={"ebs.csi": 0, "efs.csi": 1},
+    )
+    pool = fixtures.node_pool(name="default")
+    p_ebs = fixtures.pod(name="ebs-pod", requests={"cpu": "100m"})
+    p_ebs.volume_claims = ["v1"]
+    p_ebs.volume_drivers = {"v1": "ebs.csi"}
+    p_efs = fixtures.pod(name="efs-pod", requests={"cpu": "100m"})
+    p_efs.volume_claims = ["v2"]
+    p_efs.volume_drivers = {"v2": "efs.csi"}
+    pods = [p_ebs, p_efs]
+    topo = Topology([pool], {"default": its}, pods, state_node_views=[view])
+    r = Scheduler([pool], {"default": its}, topo, [view]).solve(pods)
+    assert not r.pod_errors
+    on_existing = {p.name for n in r.existing_nodes for p in n.pods}
+    assert "efs-pod" in on_existing  # efs budget (1) admits it
+    assert "ebs-pod" not in on_existing  # ebs budget (0) forces a new node
+
+    # control plane: the driver resolves through PVC -> StorageClass, and
+    # BOUND pods' volumes land in the right driver bucket too (the cluster
+    # cache resolves drivers via wire_informers before tallying — a bound
+    # ebs pod must count against ebs budgets on later solves)
+    op = small_operator()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    sc = StorageClass(metadata=ObjectMeta(name="fast"), provisioner="ebs.csi")
+    op.kube.create("StorageClass", sc)
+    pvc = PersistentVolumeClaim(storage_class_name="fast")
+    pvc.metadata.name = "data"
+    op.kube.create("PersistentVolumeClaim", pvc)
+    p = fixtures.pod(name="vol-pod", requests={"cpu": "100m"})
+    p.volume_claims = ["data"]
+    op.kube.create("Pod", p)
+    op.run_until_settled(max_ticks=30)
+    bound = op.kube.get("Pod", "vol-pod")
+    assert bound.node_name
+    sn = op.cluster.node_by_name(bound.node_name)
+    vols = sn.volume_usage.distinct_volumes()
+    assert ("ebs.csi", "data") in vols, vols

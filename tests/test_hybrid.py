@@ -359,3 +359,55 @@ def test_partition_with_nodepool_limits_matches_oracle():
         tuple(sorted(p.name for p in c.pods)) for c in r.new_node_claims if c.pods
     )
     assert parts(orc) == parts(hyb)
+
+
+def test_reserved_capacity_gate_only_fires_with_reservations():
+    """The ReservedCapacity feature gate alone doesn't change semantics —
+    only actual reservation-id offerings do (reservationmanager.go:28).
+    Flag on + no reservations rides the kernel and matches the oracle;
+    reservation offerings present still falls back."""
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.cloudprovider.types import Offering
+    from karpenter_tpu.scheduling import Requirement, Requirements
+    from karpenter_tpu.api.objects import Operator as Op
+    from karpenter_tpu.solver.oracle import SchedulerOptions
+
+    fixtures.reset_rng(7)
+    pods = fixtures.make_diverse_pods(12)
+    opts = SchedulerOptions(reserved_capacity_enabled=True, tpu_min_pods=0)
+    h = HybridScheduler(*_problem(pods), options=opts)
+    r = h.solve(pods)
+    assert h.used_tpu is True, h.fallback_reason
+    assert not r.pod_errors
+
+    fixtures.reset_rng(7)
+    pods2 = fixtures.make_diverse_pods(12)
+    want = Scheduler(*_problem(pods2)).solve(pods2)
+    assert sorted(r.node_pod_counts()) == sorted(want.node_pod_counts())
+
+    # now add a reservation-id offering -> the gate fires, oracle runs
+    fixtures.reset_rng(7)
+    pods3 = fixtures.make_diverse_pods(12)
+    its = _universe()
+    it0 = its[0]
+    it0.offerings.append(
+        Offering(
+            requirements=Requirements(
+                [
+                    Requirement(wk.TOPOLOGY_ZONE_LABEL_KEY, Op.IN, ["test-zone-a"]),
+                    Requirement(wk.CAPACITY_TYPE_LABEL_KEY, Op.IN, ["reserved"]),
+                    Requirement(wk.RESERVATION_ID_LABEL_KEY, Op.IN, ["res-1"]),
+                ]
+            ),
+            price=0.01,
+            available=True,
+            reservation_capacity=4,
+        )
+    )
+    np_ = fixtures.node_pool(name="default")
+    topo = Topology([np_], {"default": its}, pods3)
+    h3 = HybridScheduler([np_], {"default": its}, topo, options=SchedulerOptions(
+        reserved_capacity_enabled=True, tpu_min_pods=0))
+    h3.solve(pods3)
+    assert h3.used_tpu is False
+    assert "reserved" in (h3.fallback_reason or "")
