@@ -18,6 +18,10 @@ CAPACITY_TYPE_RESERVED = "reserved"
 
 # Autoscaler-specific labels
 NODEPOOL_LABEL_KEY = f"{GROUP}/nodepool"
+# NodeClass back-reference label (reference labels.go:188 NodeClassLabelKey
+# builds "<group>/<kind>"; node-class refs are plain names here, so one
+# stable key stands in for the group-kind family)
+NODECLASS_LABEL_KEY = f"{GROUP}/nodeclass"
 NODE_INITIALIZED_LABEL_KEY = f"{GROUP}/initialized"
 NODE_REGISTERED_LABEL_KEY = f"{GROUP}/registered"
 CAPACITY_TYPE_LABEL_KEY = f"{GROUP}/capacity-type"

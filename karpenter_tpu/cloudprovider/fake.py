@@ -130,6 +130,41 @@ def new_instance_type(
     )
 
 
+def default_instance_types() -> InstanceTypes:
+    """The reference fake provider's DEFAULT universe
+    (fake/cloudprovider.go:234-271): default, small, two gpu vendors, an
+    arm type with exotic operating systems, and a single-pod type. The
+    scheduling suite's instance-type-compatibility scenarios are written
+    against exactly this set."""
+    return InstanceTypes(
+        [
+            new_instance_type(name="default-instance-type"),
+            new_instance_type(
+                name="small-instance-type",
+                resources={res.CPU: q("2"), res.MEMORY: q("2Gi")},
+            ),
+            new_instance_type(
+                name="gpu-vendor-instance-type",
+                resources={RESOURCE_GPU_VENDOR_A: q("2")},
+            ),
+            new_instance_type(
+                name="gpu-vendor-b-instance-type",
+                resources={RESOURCE_GPU_VENDOR_B: q("2")},
+            ),
+            new_instance_type(
+                name="arm-instance-type",
+                architecture="arm64",
+                operating_systems={"ios", "linux", "windows", "darwin"},
+                resources={res.CPU: q("16"), res.MEMORY: q("128Gi")},
+            ),
+            new_instance_type(
+                name="single-pod-instance-type",
+                resources={res.PODS: q("1")},
+            ),
+        ]
+    )
+
+
 def instance_types(total: int) -> InstanceTypes:
     """fake.InstanceTypes(total): incrementing 1..total vCPU, 2..2*total Gi,
     10..10*total pods (fake/instancetype.go:200)."""

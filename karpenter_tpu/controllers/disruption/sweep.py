@@ -53,6 +53,7 @@ strategy (consolidation.py), falling back to binary on SweepUnsupported.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Optional
 
@@ -258,6 +259,9 @@ def prefix_feasibility(
 ) -> list[bool]:
     """[len(candidates)] — feasible(k) for removing candidates[:k+1], all
     prefixes evaluated in one vmapped device call."""
+    from karpenter_tpu.jaxsetup import ensure_compilation_cache
+
+    ensure_compilation_cache()
     import jax
     import jax.numpy as jnp
 
@@ -487,8 +491,12 @@ def prefix_feasibility(
     )
     xs_b = xs._replace(valid=jnp.asarray(valid_b))
 
+    relax = bool((problem.ntiers_r > 1).any())
     sweep = jax.jit(
-        jax.vmap(K.solve_scan, in_axes=(None, st_axes, xs_axes))
+        jax.vmap(
+            functools.partial(K.solve_scan, relax=relax),
+            in_axes=(None, st_axes, xs_axes),
+        )
     )
     st_out, kinds, slots, over = sweep(tb, st_b, xs_b)
     kinds = np.asarray(jax.device_get(kinds))  # [B, P_pad]

@@ -102,14 +102,10 @@ class NodeClaimLifecycle:
         except CreateError as e:
             nodepool = claim.nodepool_name or ""
             LAUNCH_FAILURES.inc({"nodepool": nodepool, "reason": e.reason})
-            if self.registration_health is not None:
-                self.registration_health.record_launch(nodepool, False)
             self.recorder.publish(
                 Event("NodeClaim", claim.name, "Warning", "LaunchFailed", str(e))
             )
             return self._liveness(claim)
-        if self.registration_health is not None:
-            self.registration_health.record_launch(claim.nodepool_name or "", True)
         claim.status.provider_id = launched.status.provider_id
         claim.status.node_name = launched.status.node_name
         claim.status.capacity = dict(launched.status.capacity)
@@ -159,6 +155,10 @@ class NodeClaimLifecycle:
         claim.status.node_name = node.name
         claim.status.conditions[COND_REGISTERED] = "True"
         self._update(claim)
+        # a successful registration feeds the nodepool health ring
+        # (registration.go:113-123: dry-run flip, then commit)
+        if self.registration_health is not None:
+            self.registration_health.record_launch(claim.nodepool_name or "", True)
         self.recorder.publish(
             Event("NodeClaim", claim.name, "Normal", "Registered", node.name)
         )
@@ -196,6 +196,12 @@ class NodeClaimLifecycle:
                 "liveness TTL exceeded before launch; deleting nodeclaim",
                 nodeclaim=claim.name, age_seconds=round(age, 1),
             )
+            # a claim that never made it feeds the health ring as a failure
+            # (liveness.go:89 + 156: dry-run flip, then commit)
+            if self.registration_health is not None:
+                self.registration_health.record_launch(
+                    claim.nodepool_name or "", False
+                )
             self.kube.delete("NodeClaim", claim.name)
             self.recorder.publish(
                 Event(
@@ -209,6 +215,10 @@ class NodeClaimLifecycle:
                 "liveness TTL exceeded before registration; deleting nodeclaim",
                 nodeclaim=claim.name, age_seconds=round(age, 1),
             )
+            if self.registration_health is not None:
+                self.registration_health.record_launch(
+                    claim.nodepool_name or "", False
+                )
             self.kube.delete("NodeClaim", claim.name)
             self.recorder.publish(
                 Event(

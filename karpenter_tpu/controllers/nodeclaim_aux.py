@@ -27,8 +27,9 @@ from karpenter_tpu.api.objects import (
     COND_INITIALIZED,
     NodeClaim,
     NodePool,
+    PodPhase,
 )
-from karpenter_tpu.controllers.kube import Conflict, NotFound, SimKube
+from karpenter_tpu.controllers.kube import DELETED, Conflict, NotFound, SimKube
 from karpenter_tpu.controllers.state import Cluster, is_reschedulable
 from karpenter_tpu.events import Event, Recorder
 from karpenter_tpu import metrics
@@ -172,28 +173,97 @@ class NodeClaimDisruptionConditions:
 
 
 class PodEvents:
-    """nodeclaim/podevents: stamp lastPodEventTime whenever a pod binds to
-    or leaves the claim's node (controller.go:63)."""
+    """nodeclaim/podevents: stamp lastPodEventTime on REAL pod events for
+    the claim's node (podevents/controller.go:63-99 + the Register event
+    filter at controller.go:104): a pod newly BOUND to the node, newly
+    TERMINAL (Succeeded/Failed), or newly TERMINATING (deletionTimestamp
+    set). Event-driven off the SimKube watch (round 5) — the former
+    count-delta heuristic went quiet under equal-count churn (one pod
+    leaves while another binds between reconcile ticks), wrongly letting
+    Consolidatable fire on a busy node. A finalizer-less sim delete skips
+    the terminating transition, so a DELETED event with a node name stamps
+    too (it IS that transition, compressed). Daemonset-owned pods are
+    ignored (controller.go:66) and stamps dedupe per claim within 10s
+    (dedupeTimeout, controller.go:41-44)."""
+
+    DEDUPE_SECONDS = 10.0
 
     def __init__(self, kube: SimKube, cluster: Cluster, clock):
         self.kube = kube
         self.cluster = cluster
         self.clock = clock
-        self._last_counts: dict[str, int] = {}
+        # pod uid -> (node_name, terminal, terminating): the "old object"
+        # a controller-runtime UpdateFunc sees; SimKube watches carry only
+        # the new state
+        self._seen: dict[str, tuple[str, bool, bool]] = {}
+        kube.subscribe(self._on_event)
 
     def reconcile_all(self) -> None:
-        for claim in self.kube.list("NodeClaim"):
-            node_name = claim.status.node_name
-            if not node_name:
+        """Kept for callers that tick controllers in a loop: stamping is
+        watch-driven, so a tick has nothing to poll."""
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        pod = obj
+        if pod.metadata.annotations.get("karpenter.sh/daemonset"):
+            return
+        node = pod.node_name or ""
+        terminal = str(pod.phase) in ("Succeeded", "Failed") or pod.phase in (
+            PodPhase.SUCCEEDED,
+            PodPhase.FAILED,
+        )
+        # the sim marks eviction with pod.terminating (termination.py
+        # _evict_locked); real deletes set deletion_timestamp — union both,
+        # like termination.py's own is-terminating check
+        terminating = (
+            pod.metadata.deletion_timestamp is not None or pod.terminating
+        )
+        if event == DELETED:
+            old = self._seen.pop(pod.uid, None)
+            was_terminating = old is not None and old[2]
+            if node and not was_terminating:
+                self._stamp(node)
+            return
+        old = self._seen.get(pod.uid)
+        self._seen[pod.uid] = (node, terminal, terminating)
+        if not node:
+            return
+        bound = old is None or not old[0]
+        went_terminal = terminal and (old is None or not old[1])
+        went_terminating = terminating and (old is None or not old[2])
+        if bound or went_terminal or went_terminating:
+            self._stamp(node)
+
+    def _stamp(self, node_name: str) -> None:
+        # resolve node -> claim through the cluster index (one try_get)
+        # instead of deep-copying every claim per pod event — pod churn is
+        # the highest-frequency watch stream
+        now = self.clock.now()
+        sn = self.cluster.node_by_name(node_name)
+        names: list[str]
+        if sn is not None and sn.node_claim is not None:
+            names = [sn.node_claim.name]
+        else:
+            # informer not caught up yet: fall back to the full scan
+            names = [
+                c.name
+                for c in self.kube.list("NodeClaim")
+                if c.status.node_name == node_name
+            ]
+        for name in names:
+            claim = self.kube.try_get("NodeClaim", name)
+            if claim is None or claim.status.node_name != node_name:
                 continue
-            n = len(self.cluster.pods_on(node_name))
-            if self._last_counts.get(claim.name) != n:
-                self._last_counts[claim.name] = n
-                claim.status.last_pod_event_time = self.clock.now()
-                try:
-                    self.kube.update("NodeClaim", claim)
-                except (Conflict, NotFound):
-                    pass
+            last = claim.status.last_pod_event_time
+            if last and now - last < self.DEDUPE_SECONDS:
+                return
+            claim.status.last_pod_event_time = now
+            try:
+                self.kube.update("NodeClaim", claim)
+            except (Conflict, NotFound):
+                pass
+            return
 
 
 class Expiration:
@@ -282,17 +352,20 @@ class Consistency:
     def reconcile_all(self) -> list[str]:
         problems = []
         for claim in self.kube.list("NodeClaim"):
-            if claim.status.conditions.get(COND_INITIALIZED) != "True":
-                continue
-            issue = self._check(claim)
-            want = "False" if issue else "True"
-            if claim.status.conditions.get(COND_CONSISTENT_STATE_FOUND) != want:
+            if not claim.status.provider_id:
+                continue  # consistency/controller.go:89
+            issues = self._check(claim)
+            if issues is None:
+                continue  # node missing/deleting: lifecycle+GC own that
+            cond = claim.status.conditions.get(COND_CONSISTENT_STATE_FOUND)
+            want = "False" if issues else "True"
+            if cond != want:
                 claim.status.conditions[COND_CONSISTENT_STATE_FOUND] = want
                 try:
                     self.kube.update("NodeClaim", claim)
                 except (Conflict, NotFound):
                     pass
-            if issue:
+            for issue in issues:
                 problems.append(f"{claim.name}: {issue}")
                 if self.recorder:
                     self.recorder.publish(
@@ -300,40 +373,83 @@ class Consistency:
                     )
         return problems
 
-    def _check(self, claim: NodeClaim) -> Optional[str]:
+    def _check(self, claim: NodeClaim) -> Optional[list[str]]:
+        """The NodeShape check (consistency/nodeshape.go:35-58): for every
+        resource the claim REQUESTED, the launched node's capacity must be
+        at least 90% of the expected (claim status) capacity. Returns all
+        issues found, or None when the claim is exempt (deleting, not yet
+        initialized, or its node is not singular/present — controller.go:105
+        delegates those to the lifecycle/GC controllers)."""
+        if claim.metadata.deletion_timestamp is not None:
+            return None
+        if claim.status.conditions.get(COND_INITIALIZED) != "True":
+            return None
         node = self.kube.try_get("Node", claim.status.node_name)
         if node is None:
-            return "node missing for initialized claim"
-        for name, want in claim.status.capacity.items():
+            return None
+        issues = []
+        for name, requested in claim.resources_requests.items():
+            expected = claim.status.capacity.get(name, 0)
+            if not requested or not expected:
+                continue
             got = node.capacity.get(name, 0)
-            if got < want:
-                return (
-                    f"node capacity {name} {got} below claim capacity {want}"
+            pct = got / expected
+            if pct < 0.90:
+                issues.append(
+                    f"expected {expected} of resource {name}, but found "
+                    f"{got} ({pct * 100:.1f}% of expected)"
                 )
-        return None
+        return issues
 
 
 class Hydration:
-    """nodeclaim+node hydration (upgrade backfill): ensure objects carry the
-    fields newer controllers expect — here the nodepool hash-version
-    annotation and the nodepool label on nodes."""
+    """nodeclaim+node hydration (upgrade backfill): ensure objects carry
+    the fields newer controllers expect. Mirrors BOTH reference hydration
+    controllers: nodeclaim/hydration/controller.go:56-77 (the node-class
+    label onto the NodeClaim) and node/hydration/controller.go:58-80 (the
+    same label onto the claim's Node), plus the nodepool drift-hash
+    annotations pre-hash-versioning claims lack."""
 
     def __init__(self, kube: SimKube):
         self.kube = kube
 
     def reconcile_all(self) -> None:
         nodepools = {np.name: np for np in self.kube.list("NodePool")}
+        class_of_node: dict[str, str] = {}
         for claim in self.kube.list("NodeClaim"):
             np = nodepools.get(claim.nodepool_name)
-            if np is None:
-                continue
+            changed = False
             ann = claim.metadata.annotations
-            if well_known.NODEPOOL_HASH_ANNOTATION_KEY not in ann:
+            if np is not None and well_known.NODEPOOL_HASH_ANNOTATION_KEY not in ann:
                 ann[well_known.NODEPOOL_HASH_ANNOTATION_KEY] = nodepool_hash(np)
                 ann[well_known.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = (
                     NODEPOOL_HASH_VERSION
                 )
+                changed = True
+            # nodeclaim/hydration/controller.go:68: the node-class label
+            labels = claim.metadata.labels
+            if claim.node_class_ref and (
+                labels.get(well_known.NODECLASS_LABEL_KEY) != claim.node_class_ref
+            ):
+                labels[well_known.NODECLASS_LABEL_KEY] = claim.node_class_ref
+                changed = True
+            if claim.status.node_name and claim.node_class_ref:
+                class_of_node[claim.status.node_name] = claim.node_class_ref
+            if changed:
                 try:
                     self.kube.update("NodeClaim", claim)
                 except (Conflict, NotFound):
                     pass
+        # node/hydration/controller.go:74: same label onto the Node
+        for node in self.kube.list("Node"):
+            ref = class_of_node.get(node.name)
+            if (
+                not ref
+                or node.metadata.labels.get(well_known.NODECLASS_LABEL_KEY) == ref
+            ):
+                continue
+            node.metadata.labels[well_known.NODECLASS_LABEL_KEY] = ref
+            try:
+                self.kube.update("Node", node)
+            except (Conflict, NotFound):
+                pass

@@ -63,6 +63,11 @@ class Operator:
     ):
         self.clock = clock or FakeClock()
         self.opts = options or Options()
+        # persistent XLA compile cache: a restarted operator must not pay
+        # a cold compile inside a Solve (provisioner.go:366 1-min budget)
+        from karpenter_tpu.jaxsetup import ensure_compilation_cache
+
+        ensure_compilation_cache()
         # structured logging (reference operator/logging/logging.go): one
         # JSON-lines root, level from options, timestamps from the sim clock
         from karpenter_tpu import logging as klog

@@ -254,6 +254,12 @@ class Provisioner:
         return out
 
     def _validate(self, pod: Pod) -> Optional[str]:
+        """provisioner.go:504 Validate: the karpenter-managed-label opt-out,
+        node selector + required-affinity requirement validation (restricted
+        labels/domains, operators, value shapes — validateNodeSelector /
+        validateAffinity via v1.ValidateRequirement), then PVC checks."""
+        from karpenter_tpu.controllers.nodepool_aux import validate_requirement
+
         # karpenter.sh/nodepool DoesNotExist opt-out (provisioner.go:538)
         na = pod.node_affinity
         terms = na.required_terms if na is not None else []
@@ -264,6 +270,17 @@ class Provisioner:
                     and e.operator == Operator.DOES_NOT_EXIST
                 ):
                     return "pod opted out of provisioning (nodepool DoesNotExist)"
+        for k, v in pod.node_selector.items():
+            err = validate_requirement(
+                NodeSelectorRequirement(k, Operator.IN, [v])
+            )
+            if err is not None:
+                return err
+        for term in terms:
+            for e in term.match_expressions:
+                err = validate_requirement(e)
+                if err is not None:
+                    return err
         return self.volume_topology.validate(pod)
 
     def _reschedulable_from_deleting_nodes(self) -> list[Pod]:

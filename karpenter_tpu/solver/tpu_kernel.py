@@ -780,11 +780,16 @@ def _step_relax(tb: Tables, st: State, x: PodX):
     return jax.lax.cond(x.ntiers > 1, tiers, plain, None)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def solve_scan(tb: Tables, st: State, xs: PodX):
+@functools.partial(jax.jit, static_argnames=("relax",))
+def solve_scan(tb: Tables, st: State, xs: PodX, relax: bool = True):
     """Run the greedy pack over a pod batch; returns
     (state, kinds, slots, overflowed) — overflowed means some pod failed
-    only because claim slots ran out (host should grow N and re-solve)."""
-    step = functools.partial(_step_relax, tb)
+    only because claim slots ran out (host should grow N and re-solve).
+
+    `relax` is trace-time static: problems with no relaxable requirement
+    classes (every ntiers == 1) compile the plain `_step` with no tier
+    loop or branch — byte-equivalent to the pre-relaxation program, so
+    preference-free workloads pay nothing for the ladder machinery."""
+    step = functools.partial(_step_relax if relax else _step, tb)
     st, (kinds, slots, overflow) = jax.lax.scan(step, st, xs)
     return st, kinds, slots, jnp.any(overflow)

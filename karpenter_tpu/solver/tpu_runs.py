@@ -315,10 +315,15 @@ def _record_window(st, tb, finals: Reqs, slots, preds, selv, selh, ownh, allow_w
 # the driver
 
 
-@functools.partial(jax.jit, static_argnames=())
-def solve_runs(tb: Tables, st: State, rx: RunX, seq, next_seq, n_valid):
+@functools.partial(jax.jit, static_argnames=("relax",))
+def solve_runs(
+    tb: Tables, st: State, rx: RunX, seq, next_seq, n_valid,
+    relax: bool = True,
+):
     """Returns (state, seq, next_seq, kinds[P], slots[P], overflowed, iters).
-    Pods at index >= n_valid are shape padding and are never visited."""
+    Pods at index >= n_valid are shape padding and are never visited.
+    `relax` is trace-time static (see tpu_kernel.solve_scan): preference-
+    free problems compile the plain exact step with no tier machinery."""
     P = rx.is_head.shape[0]
     N = st.active.shape[0]
     E = st.eavail.shape[0]
@@ -346,7 +351,8 @@ def solve_runs(tb: Tables, st: State, rx: RunX, seq, next_seq, n_valid):
         # only ever uses rank for min-selection (its rank updates are
         # discarded here), so the key substitutes directly — no sort
         st_in = st._replace(rank=_seq_key(st.count, seq, st.active))
-        st2, (kind, slot, oflow) = K._step_relax(tb, st_in, x)
+        step_fn = K._step_relax if relax else K._step
+        st2, (kind, slot, oflow) = step_fn(tb, st_in, x)
         joined = kind == KIND_CLAIM
         created = kind == KIND_NEW
         upd = joined | created

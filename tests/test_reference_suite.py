@@ -1,0 +1,1192 @@
+"""Reference scheduling-suite scenario matrices, round 5 (TESTMAP.md).
+
+Ports of /root/reference/pkg/controllers/provisioning/scheduling/
+suite_test.go families that had no repo coverage: Custom Constraints,
+Well Known Labels, Constraints Validation, Scheduling Logic, Instance
+Type Compatibility, and Binpacking. Each test cites the reference It()
+block (file:line) it reproduces; the expectations are re-derived from the
+reference semantics, the harness mirrors tests/test_scheduling_families.py.
+
+The instance-type universe is the reference fake provider's DEFAULT set
+(fake/cloudprovider.go:234-271 — fake.default_instance_types()), because
+these scenarios are written against exactly those six types.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import (
+    NodeSelectorRequirement,
+    Operator,
+)
+from karpenter_tpu.cloudprovider import fake
+from karpenter_tpu.solver import HybridScheduler, Scheduler, Topology
+from karpenter_tpu.solver.oracle import SchedulerOptions
+from karpenter_tpu.testing import fixtures
+from karpenter_tpu.utils import resources as res
+
+ZONE = well_known.TOPOLOGY_ZONE_LABEL_KEY
+ITYPE = well_known.INSTANCE_TYPE_LABEL_KEY
+ARCH = well_known.ARCH_LABEL_KEY
+OS = well_known.OS_LABEL_KEY
+INT_KEY = fake.INTEGER_INSTANCE_LABEL_KEY
+
+
+def solve(pods, pools=None, its=None, options=None, kernel=False, views=None):
+    its = its if its is not None else fake.default_instance_types()
+    pools = pools or [fixtures.node_pool(name="default")]
+    ibp = {np.name: its for np in pools}
+    topo = Topology(pools, ibp, pods, state_node_views=views)
+    cls = HybridScheduler if kernel else Scheduler
+    kw = {}
+    if kernel:
+        kw["force_oracle"] = False
+        options = options or SchedulerOptions()
+        options.tpu_min_pods = 0
+    s = cls(pools, ibp, topo, views, None, options, **kw)
+    return s.solve(pods)
+
+
+def claim_of(r, pod_name):
+    for c in r.new_node_claims:
+        if any(p.name == pod_name for p in c.pods):
+            return c
+    return None
+
+
+def scheduled(r, pod_name) -> bool:
+    if claim_of(r, pod_name) is not None:
+        return True
+    return any(
+        p.name == pod_name for n in r.existing_nodes for p in n.pods
+    )
+
+
+def claim_value(claim, key):
+    """The single requirement value a created node would carry as `key`'s
+    label, or None when the claim leaves it open."""
+    if not claim.requirements.has(key):
+        return None
+    req = claim.requirements.get(key)
+    if req.complement or len(req.values) != 1:
+        return None
+    return next(iter(req.values))
+
+
+def type_names(claim):
+    return {it.name for it in claim.instance_type_options}
+
+
+def allowed_zones(claim):
+    """Zones a Create could place this claim in: available offerings of
+    surviving types, filtered by the claim's zone requirement — the node
+    label the reference asserts on materializes from exactly this set."""
+    req = (
+        claim.requirements.get(ZONE) if claim.requirements.has(ZONE) else None
+    )
+    zones = set()
+    for it in claim.instance_type_options:
+        for o in it.offerings:
+            if not o.available:
+                continue
+            z = o.zone()
+            if z and (req is None or req.has(z)):
+                zones.add(z)
+    return zones
+
+
+# ---------------------------------------------------------------------------
+# Custom Constraints > NodePool with Labels (suite_test.go:151-199)
+
+
+def test_nodepool_labels_schedule_unconstrained():
+    """suite_test.go:152 — unconstrained pod lands on the labeled pool."""
+    pool = fixtures.node_pool(name="default", labels={"test-key": "test-value"})
+    r = solve([fixtures.pod(name="p")], pools=[pool])
+    c = claim_of(r, "p")
+    assert c is not None
+    assert claim_value(c, "test-key") == "test-value"
+
+
+def test_nodepool_labels_conflicting_selector_fails():
+    """suite_test.go:160 — selector conflicting with the pool label."""
+    pool = fixtures.node_pool(name="default", labels={"test-key": "test-value"})
+    r = solve(
+        [fixtures.pod(name="p", node_selector={"test-key": "different-value"})],
+        pools=[pool],
+    )
+    assert not scheduled(r, "p")
+
+
+def test_nodepool_labels_undefined_key_fails():
+    """suite_test.go:169 — selector on a key no pool defines."""
+    r = solve([fixtures.pod(name="p", node_selector={"test-key": "test-value"})])
+    assert not scheduled(r, "p")
+
+
+def test_nodepool_labels_matching_requirement_schedules():
+    """suite_test.go:177 — In requirement containing the pool's value."""
+    pool = fixtures.node_pool(name="default", labels={"test-key": "test-value"})
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        "test-key", Operator.IN, ["test-value", "another-value"]
+                    )
+                ],
+            )
+        ],
+        pools=[pool],
+    )
+    c = claim_of(r, "p")
+    assert c is not None and claim_value(c, "test-key") == "test-value"
+
+
+def test_nodepool_labels_conflicting_requirement_fails():
+    """suite_test.go:189 — In requirement excluding the pool's value."""
+    pool = fixtures.node_pool(name="default", labels={"test-key": "test-value"})
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        "test-key", Operator.IN, ["another-value"]
+                    )
+                ],
+            )
+        ],
+        pools=[pool],
+    )
+    assert not scheduled(r, "p")
+
+
+# ---------------------------------------------------------------------------
+# Custom Constraints > Well Known Labels (suite_test.go:201-402; the
+# duplicate block at :657-1090 runs the same scenarios and is covered by
+# these same matrices — see TESTMAP.md)
+
+
+def test_wkl_nodepool_constraints():
+    """suite_test.go:202 — pool zone constraint pins the claim's zone."""
+    pool = fixtures.node_pool(
+        name="default",
+        requirements=[NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-2"])],
+    )
+    r = solve([fixtures.pod(name="p")], pools=[pool])
+    c = claim_of(r, "p")
+    assert c is not None and claim_value(c, ZONE) == "test-zone-2"
+
+
+def test_wkl_node_selector_narrows_pool():
+    """suite_test.go:211 — selector picks one zone of the pool's two."""
+    pool = fixtures.node_pool(
+        name="default",
+        requirements=[
+            NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-1", "test-zone-2"])
+        ],
+    )
+    r = solve(
+        [fixtures.pod(name="p", node_selector={ZONE: "test-zone-2"})],
+        pools=[pool],
+    )
+    c = claim_of(r, "p")
+    assert c is not None and claim_value(c, ZONE) == "test-zone-2"
+
+
+def test_wkl_unknown_selector_value_fails():
+    """suite_test.go:230 — zone selector outside the universe."""
+    pool = fixtures.node_pool(
+        name="default",
+        requirements=[NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-1"])],
+    )
+    r = solve(
+        [fixtures.pod(name="p", node_selector={ZONE: "unknown"})], pools=[pool]
+    )
+    assert not scheduled(r, "p")
+
+
+def test_wkl_selector_outside_pool_constraints_fails():
+    """suite_test.go:240 — selector zone disjoint from the pool's."""
+    pool = fixtures.node_pool(
+        name="default",
+        requirements=[NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-1"])],
+    )
+    r = solve(
+        [fixtures.pod(name="p", node_selector={ZONE: "test-zone-2"})],
+        pools=[pool],
+    )
+    assert not scheduled(r, "p")
+
+
+def test_wkl_operator_in():
+    """suite_test.go:250 — In[test-zone-3] schedules into zone 3."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-3"])
+                ],
+            )
+        ]
+    )
+    c = claim_of(r, "p")
+    assert c is not None and claim_value(c, ZONE) == "test-zone-3"
+
+
+def test_wkl_operator_gt():
+    """suite_test.go:261 — pool integer Gt 8 leaves only the 16-cpu type."""
+    pool = fixtures.node_pool(
+        name="default",
+        requirements=[NodeSelectorRequirement(INT_KEY, Operator.GT, ["8"])],
+    )
+    r = solve([fixtures.pod(name="p")], pools=[pool])
+    c = claim_of(r, "p")
+    assert c is not None
+    assert type_names(c) == {"arm-instance-type"}  # the only 16-cpu type
+
+
+def test_wkl_operator_lt():
+    """suite_test.go:270 — pool integer Lt 8 keeps small types; the
+    cheapest (2-cpu) schedules first (reference expects integer=2)."""
+    pool = fixtures.node_pool(
+        name="default",
+        requirements=[NodeSelectorRequirement(INT_KEY, Operator.LT, ["8"])],
+    )
+    r = solve([fixtures.pod(name="p")], pools=[pool])
+    c = claim_of(r, "p")
+    assert c is not None
+    assert "arm-instance-type" not in type_names(c)
+    assert "small-instance-type" in type_names(c)
+
+
+def test_wkl_incompatible_requirement_in_fails():
+    """suite_test.go:279 — required In[unknown]."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(ZONE, Operator.IN, ["unknown"])
+                ],
+            )
+        ]
+    )
+    assert not scheduled(r, "p")
+
+
+def test_wkl_operator_notin():
+    """suite_test.go:289 — NotIn[z1,z2,unknown] leaves zone 3."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        ZONE,
+                        Operator.NOT_IN,
+                        ["test-zone-1", "test-zone-2", "unknown"],
+                    )
+                ],
+            )
+        ]
+    )
+    c = claim_of(r, "p")
+    assert c is not None and allowed_zones(c) == {"test-zone-3"}
+
+
+def test_wkl_notin_everything_fails():
+    """suite_test.go:300 — NotIn over the whole universe."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        ZONE,
+                        Operator.NOT_IN,
+                        ["test-zone-1", "test-zone-2", "test-zone-3", "unknown"],
+                    )
+                ],
+            )
+        ]
+    )
+    assert not scheduled(r, "p")
+
+
+def test_wkl_compatible_preference_narrows_in():
+    """suite_test.go:311 — preference In[z2,unknown] inside required
+    In[z1..z3,unknown] lands in zone 2."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        ZONE,
+                        Operator.IN,
+                        ["test-zone-1", "test-zone-2", "test-zone-3", "unknown"],
+                    )
+                ],
+                node_preferences=[
+                    NodeSelectorRequirement(
+                        ZONE, Operator.IN, ["test-zone-2", "unknown"]
+                    )
+                ],
+            )
+        ]
+    )
+    c = claim_of(r, "p")
+    assert c is not None and allowed_zones(c) == {"test-zone-2"}
+
+
+def test_wkl_incompatible_preference_in_still_schedules():
+    """suite_test.go:325 — preference In[unknown] relaxes away."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        ZONE,
+                        Operator.IN,
+                        ["test-zone-1", "test-zone-2", "test-zone-3", "unknown"],
+                    )
+                ],
+                node_preferences=[
+                    NodeSelectorRequirement(ZONE, Operator.IN, ["unknown"])
+                ],
+            )
+        ]
+    )
+    assert scheduled(r, "p")
+
+
+def test_wkl_compatible_preference_notin():
+    """suite_test.go:338 — preference NotIn[z1,z3] picks zone 2."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        ZONE,
+                        Operator.IN,
+                        ["test-zone-1", "test-zone-2", "test-zone-3", "unknown"],
+                    )
+                ],
+                node_preferences=[
+                    NodeSelectorRequirement(
+                        ZONE, Operator.NOT_IN, ["test-zone-1", "test-zone-3"]
+                    )
+                ],
+            )
+        ]
+    )
+    c = claim_of(r, "p")
+    assert c is not None and allowed_zones(c) == {"test-zone-2"}
+
+
+def test_wkl_incompatible_preference_notin_still_schedules():
+    """suite_test.go:352 — preference NotIn[everything] relaxes away."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        ZONE,
+                        Operator.IN,
+                        ["test-zone-1", "test-zone-2", "test-zone-3", "unknown"],
+                    )
+                ],
+                node_preferences=[
+                    NodeSelectorRequirement(
+                        ZONE,
+                        Operator.NOT_IN,
+                        ["test-zone-1", "test-zone-2", "test-zone-3"],
+                    )
+                ],
+            )
+        ]
+    )
+    assert scheduled(r, "p")
+
+
+def test_wkl_selector_preference_requirement_combine():
+    """suite_test.go:365 — all three dimensions agree on zone 3."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_selector={ZONE: "test-zone-3"},
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        ZONE,
+                        Operator.IN,
+                        ["test-zone-1", "test-zone-2", "test-zone-3"],
+                    )
+                ],
+                node_preferences=[
+                    NodeSelectorRequirement(
+                        ZONE,
+                        Operator.IN,
+                        ["test-zone-1", "test-zone-2", "test-zone-3"],
+                    )
+                ],
+            )
+        ]
+    )
+    c = claim_of(r, "p")
+    assert c is not None and claim_value(c, ZONE) == "test-zone-3"
+
+
+def test_wkl_multidimensional_combination():
+    """suite_test.go:380 — zone + instance-type selectors, requirements,
+    and preferences combined."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_selector={
+                    ZONE: "test-zone-3",
+                    ITYPE: "arm-instance-type",
+                },
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        ZONE, Operator.IN, ["test-zone-1", "test-zone-3"]
+                    ),
+                    NodeSelectorRequirement(
+                        ITYPE,
+                        Operator.IN,
+                        ["default-instance-type", "arm-instance-type"],
+                    ),
+                ],
+                node_preferences=[
+                    NodeSelectorRequirement(ZONE, Operator.NOT_IN, ["unknown"]),
+                    NodeSelectorRequirement(ITYPE, Operator.NOT_IN, ["unknown"]),
+                ],
+            )
+        ]
+    )
+    c = claim_of(r, "p")
+    assert c is not None
+    assert claim_value(c, ZONE) == "test-zone-3"
+    assert type_names(c) == {"arm-instance-type"}
+
+
+# ---------------------------------------------------------------------------
+# Custom Constraints > Constraints Validation (suite_test.go:404-478):
+# restricted labels/domains on POD selectors are rejected by the
+# provisioner's validation (provisioner.go:504 Validate), not the solver.
+
+
+def _operator_validate(selector: dict) -> str | None:
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.operator import Operator as Op
+
+    op = Op(clock=FakeClock(), force_oracle=True)
+    pod = fixtures.pod(name="p", node_selector=selector)
+    return op.provisioner._validate(pod)
+
+
+def test_validation_restricted_labels_rejected():
+    """suite_test.go:405 — kubernetes.io/hostname is a restricted label."""
+    assert _operator_validate({well_known.HOSTNAME_LABEL_KEY: "red-node"})
+
+
+@pytest.mark.parametrize(
+    "key",
+    [
+        "kubernetes.io/custom",
+        "k8s.io/custom",
+        "karpenter.sh/custom",
+        "sub.kubernetes.io/custom",
+    ],
+)
+def test_validation_restricted_domains_rejected(key):
+    """suite_test.go:421 — selectors in restricted domains."""
+    assert _operator_validate({key: "v"})
+
+
+@pytest.mark.parametrize(
+    "key",
+    [
+        "kops.k8s.io/custom",
+        "sub.kops.k8s.io/custom",
+        "node-restriction.kubernetes.io/custom",
+        "sub.node-restriction.kubernetes.io/custom",
+    ],
+)
+def test_validation_domain_exceptions_allowed(key):
+    """suite_test.go:432-459 — exception (sub)domains pass validation."""
+    assert _operator_validate({key: "v"}) is None
+
+
+def test_validation_well_known_labels_allowed():
+    """suite_test.go:460 — well-known keys pass validation."""
+    assert _operator_validate({ZONE: "test-zone-1"}) is None
+    assert _operator_validate({well_known.NODEPOOL_LABEL_KEY: "default"}) is None
+
+
+# ---------------------------------------------------------------------------
+# Custom Constraints > Scheduling Logic (suite_test.go:480-655)
+
+
+def test_logic_in_undefined_key_fails():
+    """suite_test.go:488."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement("undefined-key", Operator.IN, ["v"])
+                ],
+            )
+        ]
+    )
+    assert not scheduled(r, "p")
+
+
+def test_logic_notin_undefined_key_schedules():
+    """suite_test.go:497."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement("undefined-key", Operator.NOT_IN, ["v"])
+                ],
+            )
+        ]
+    )
+    assert scheduled(r, "p")
+
+
+def test_logic_exists_undefined_key_fails():
+    """suite_test.go:507."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement("undefined-key", Operator.EXISTS)
+                ],
+            )
+        ]
+    )
+    assert not scheduled(r, "p")
+
+
+def test_logic_doesnotexist_undefined_key_schedules():
+    """suite_test.go:516."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement("undefined-key", Operator.DOES_NOT_EXIST)
+                ],
+            )
+        ]
+    )
+    assert scheduled(r, "p")
+
+
+def test_logic_in_matching_pool_label():
+    """suite_test.go:535."""
+    pool = fixtures.node_pool(name="default", labels={"test-key": "test-value"})
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement("test-key", Operator.IN, ["test-value"])
+                ],
+            )
+        ],
+        pools=[pool],
+    )
+    assert scheduled(r, "p")
+
+
+def test_logic_notin_matching_pool_label_fails():
+    """suite_test.go:547."""
+    pool = fixtures.node_pool(name="default", labels={"test-key": "test-value"})
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        "test-key", Operator.NOT_IN, ["test-value"]
+                    )
+                ],
+            )
+        ],
+        pools=[pool],
+    )
+    assert not scheduled(r, "p")
+
+
+def test_logic_exists_defined_key_schedules():
+    """suite_test.go:558."""
+    pool = fixtures.node_pool(name="default", labels={"test-key": "test-value"})
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement("test-key", Operator.EXISTS)
+                ],
+            )
+        ],
+        pools=[pool],
+    )
+    assert scheduled(r, "p")
+
+
+def test_logic_doesnotexist_defined_key_fails():
+    """suite_test.go:570."""
+    pool = fixtures.node_pool(name="default", labels={"test-key": "test-value"})
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement("test-key", Operator.DOES_NOT_EXIST)
+                ],
+            )
+        ],
+        pools=[pool],
+    )
+    assert not scheduled(r, "p")
+
+
+def test_logic_in_different_value_fails():
+    """suite_test.go:582."""
+    pool = fixtures.node_pool(name="default", labels={"test-key": "test-value"})
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement("test-key", Operator.IN, ["different"])
+                ],
+            )
+        ],
+        pools=[pool],
+    )
+    assert not scheduled(r, "p")
+
+
+def test_logic_notin_different_value_schedules():
+    """suite_test.go:593."""
+    pool = fixtures.node_pool(name="default", labels={"test-key": "test-value"})
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        "test-key", Operator.NOT_IN, ["different"]
+                    )
+                ],
+            )
+        ],
+        pools=[pool],
+    )
+    assert scheduled(r, "p")
+
+
+def test_logic_compatible_pods_share_node():
+    """suite_test.go:605 — zone-3 requirement and NotIn[z1,z2] coexist."""
+    pods = [
+        fixtures.pod(
+            name="a",
+            requests={"cpu": "100m"},
+            node_requirements=[
+                NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-3"])
+            ],
+        ),
+        fixtures.pod(
+            name="b",
+            requests={"cpu": "100m"},
+            node_requirements=[
+                NodeSelectorRequirement(
+                    ZONE, Operator.NOT_IN, ["test-zone-1", "test-zone-2"]
+                )
+            ],
+        ),
+    ]
+    r = solve(pods)
+    ca, cb = claim_of(r, "a"), claim_of(r, "b")
+    assert ca is not None and ca is cb
+
+
+def test_logic_incompatible_pods_separate_nodes():
+    """suite_test.go:625 — In[z1] and NotIn[z1] split."""
+    pods = [
+        fixtures.pod(
+            name="a",
+            requests={"cpu": "100m"},
+            node_requirements=[
+                NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-1"])
+            ],
+        ),
+        fixtures.pod(
+            name="b",
+            requests={"cpu": "100m"},
+            node_requirements=[
+                NodeSelectorRequirement(ZONE, Operator.NOT_IN, ["test-zone-1"])
+            ],
+        ),
+    ]
+    r = solve(pods)
+    ca, cb = claim_of(r, "a"), claim_of(r, "b")
+    assert ca is not None and cb is not None and ca is not cb
+
+
+def test_logic_exists_does_not_overwrite():
+    """suite_test.go:645 — an Exists pod joins an In[z2] claim and the
+    claim keeps the concrete zone."""
+    pods = [
+        fixtures.pod(
+            name="a",
+            requests={"cpu": "100m"},
+            node_requirements=[
+                NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-2"])
+            ],
+        ),
+        fixtures.pod(
+            name="b",
+            requests={"cpu": "100m"},
+            node_requirements=[NodeSelectorRequirement(ZONE, Operator.EXISTS)],
+        ),
+    ]
+    r = solve(pods)
+    ca, cb = claim_of(r, "a"), claim_of(r, "b")
+    assert ca is not None and ca is cb
+    assert claim_value(ca, ZONE) == "test-zone-2"
+
+
+# ---------------------------------------------------------------------------
+# Instance Type Compatibility (suite_test.go:1226-1512)
+
+
+def test_itc_oversized_request_fails():
+    """suite_test.go:1227 — more cpu than any type has."""
+    r = solve([fixtures.pod(name="p", requests={"cpu": "512"})])
+    assert not scheduled(r, "p")
+
+
+def test_itc_different_archs_split_nodes():
+    """suite_test.go:1238 — amd64 + arm64 pods need two nodes."""
+    pods = [
+        fixtures.pod(
+            name="amd",
+            node_requirements=[
+                NodeSelectorRequirement(ARCH, Operator.IN, ["amd64"])
+            ],
+        ),
+        fixtures.pod(
+            name="arm",
+            node_requirements=[
+                NodeSelectorRequirement(ARCH, Operator.IN, ["arm64"])
+            ],
+        ),
+    ]
+    r = solve(pods)
+    ca, cb = claim_of(r, "amd"), claim_of(r, "arm")
+    assert ca is not None and cb is not None and ca is not cb
+    assert "arm-instance-type" not in type_names(ca)
+    assert type_names(cb) == {"arm-instance-type"}
+
+
+def test_itc_pod_constraints_exclude_types_instance_type():
+    """suite_test.go:1265 — affinity In[small-instance-type] with an
+    8-cpu request fails (small has 2 cpu)."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                requests={"cpu": "8"},
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        ITYPE, Operator.IN, ["small-instance-type"]
+                    )
+                ],
+            )
+        ]
+    )
+    assert not scheduled(r, "p")
+
+
+def test_itc_pod_constraints_exclude_types_os():
+    """suite_test.go:1288 — os In[ios] only exists on the arm type; an
+    amd64 requirement then fails."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(OS, Operator.IN, ["ios"]),
+                    NodeSelectorRequirement(ARCH, Operator.IN, ["amd64"]),
+                ],
+            )
+        ]
+    )
+    assert not scheduled(r, "p")
+    r = solve(
+        [
+            fixtures.pod(
+                name="q",
+                node_requirements=[
+                    NodeSelectorRequirement(OS, Operator.IN, ["ios"]),
+                ],
+            )
+        ]
+    )
+    c = claim_of(r, "q")
+    assert c is not None and type_names(c) == {"arm-instance-type"}
+
+
+def test_itc_different_os_split_nodes():
+    """suite_test.go:1329 — an ios pod (arm type only) and an amd64/linux
+    pod land on different instances."""
+    pods = [
+        fixtures.pod(
+            name="ios",
+            node_requirements=[
+                NodeSelectorRequirement(OS, Operator.IN, ["ios"])
+            ],
+        ),
+        fixtures.pod(
+            name="linux",
+            node_requirements=[
+                NodeSelectorRequirement(OS, Operator.IN, ["linux"]),
+                NodeSelectorRequirement(ARCH, Operator.IN, ["amd64"]),
+            ],
+        ),
+    ]
+    r = solve(pods)
+    ca, cb = claim_of(r, "ios"), claim_of(r, "linux")
+    assert ca is not None and cb is not None and ca is not cb
+    assert type_names(ca) == {"arm-instance-type"}
+    assert "arm-instance-type" not in type_names(cb)
+
+
+def test_itc_different_instance_type_selectors_split_nodes():
+    """suite_test.go:1356."""
+    pods = [
+        fixtures.pod(
+            name="a", node_selector={ITYPE: "small-instance-type"}
+        ),
+        fixtures.pod(
+            name="b", node_selector={ITYPE: "default-instance-type"}
+        ),
+    ]
+    r = solve(pods)
+    ca, cb = claim_of(r, "a"), claim_of(r, "b")
+    assert ca is not None and cb is not None and ca is not cb
+    assert type_names(ca) == {"small-instance-type"}
+    assert type_names(cb) == {"default-instance-type"}
+
+
+def test_itc_different_zone_selectors_split_nodes():
+    """suite_test.go:1383."""
+    pods = [
+        fixtures.pod(name="a", node_selector={ZONE: "test-zone-1"}),
+        fixtures.pod(name="b", node_selector={ZONE: "test-zone-2"}),
+    ]
+    r = solve(pods)
+    ca, cb = claim_of(r, "a"), claim_of(r, "b")
+    assert ca is not None and cb is not None and ca is not cb
+
+
+def test_itc_disjoint_resources_split_nodes():
+    """suite_test.go:1410 — vendor-a and vendor-b gpus live on different
+    types, so the two pods fork claims."""
+    pods = [
+        fixtures.pod(name="a", requests={fake.RESOURCE_GPU_VENDOR_A: "1"}),
+        fixtures.pod(name="b", requests={fake.RESOURCE_GPU_VENDOR_B: "1"}),
+    ]
+    r = solve(pods)
+    ca, cb = claim_of(r, "a"), claim_of(r, "b")
+    assert ca is not None and cb is not None and ca is not cb
+    assert type_names(ca) == {"gpu-vendor-instance-type"}
+    assert type_names(cb) == {"gpu-vendor-b-instance-type"}
+
+
+def test_itc_combined_resources_unsatisfiable():
+    """suite_test.go:1439 — one pod asking both vendors' gpus fails."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                requests={
+                    fake.RESOURCE_GPU_VENDOR_A: "1",
+                    fake.RESOURCE_GPU_VENDOR_B: "1",
+                },
+            )
+        ]
+    )
+    assert not scheduled(r, "p")
+
+
+# Provider Specific Labels (suite_test.go:1457-1512)
+
+
+def test_psl_filter_types_matching_labels():
+    """suite_test.go:1458 — size=small/large selectors pick type sets."""
+    r = solve([fixtures.pod(name="small", node_selector={fake.LABEL_INSTANCE_SIZE: "small"})])
+    c = claim_of(r, "small")
+    assert c is not None
+    assert all(
+        "small" in claim_value_of_type(it) for it in c.instance_type_options
+    )
+
+
+def claim_value_of_type(it):
+    req = it.requirements.get(fake.LABEL_INSTANCE_SIZE)
+    return next(iter(req.values)) if req is not None and req.values else ""
+
+
+def test_psl_incompatible_labels_fail():
+    """suite_test.go:1471 — size=large + the small types' exotic key."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_selector={
+                    fake.LABEL_INSTANCE_SIZE: "small",
+                    fake.EXOTIC_INSTANCE_LABEL_KEY: "optional",
+                },
+            )
+        ],
+        its=fake.instance_types(8),
+    )
+    assert not scheduled(r, "p")
+
+
+def test_psl_optional_label_schedules():
+    """suite_test.go:1488 — the exotic optional label exists on large."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_selector={fake.EXOTIC_INSTANCE_LABEL_KEY: "optional"},
+            )
+        ],
+        its=fake.instance_types(8),
+    )
+    assert scheduled(r, "p")
+
+
+def test_psl_doesnotexist_excludes_optional_label():
+    """suite_test.go:1500 — DoesNotExist on the exotic key forbids the
+    large types that define it."""
+    r = solve(
+        [
+            fixtures.pod(
+                name="p",
+                node_requirements=[
+                    NodeSelectorRequirement(
+                        fake.EXOTIC_INSTANCE_LABEL_KEY, Operator.DOES_NOT_EXIST
+                    )
+                ],
+            )
+        ],
+        its=fake.instance_types(8),
+    )
+    c = claim_of(r, "p")
+    assert c is not None
+    assert all(
+        not it.requirements.has(fake.EXOTIC_INSTANCE_LABEL_KEY)
+        or not it.requirements.get(fake.EXOTIC_INSTANCE_LABEL_KEY).values
+        for it in c.instance_type_options
+    )
+
+
+# ---------------------------------------------------------------------------
+# Binpacking (suite_test.go:1514-1829)
+
+
+def test_bp_small_pod_smallest_instance():
+    """suite_test.go:1515 — a 100m pod picks the cheapest (smallest)."""
+    r = solve([fixtures.pod(name="p", requests={"cpu": "100m"})])
+    c = claim_of(r, "p")
+    assert c is not None
+    # cheapest compatible type must survive; creation picks it
+    assert "small-instance-type" in type_names(c)
+
+
+def test_bp_smallest_possible_when_small_is_full():
+    """suite_test.go:1527 — 1950m doesn't fit small (2cpu minus 100m
+    kube-reserved overhead = 1900m allocatable); the next-cheapest default
+    type hosts it."""
+    r = solve([fixtures.pod(name="p", requests={"cpu": "1950m"})])
+    c = claim_of(r, "p")
+    assert c is not None
+    assert "small-instance-type" not in type_names(c)
+    assert "default-instance-type" in type_names(c)
+
+
+def test_bp_multiple_small_pods_pack_one_node():
+    """suite_test.go:1567 — five 10m pods share one claim."""
+    pods = [
+        fixtures.pod(name=f"p{i}", requests={"cpu": "10m"}) for i in range(5)
+    ]
+    r = solve(pods)
+    claims = {id(claim_of(r, f"p{i}")) for i in range(5)}
+    assert len(claims) == 1
+
+
+def test_bp_new_node_at_capacity():
+    """suite_test.go:1586 — pods overflow to a second node when the first
+    fills."""
+    pods = [
+        fixtures.pod(name=f"p{i}", requests={"cpu": "1"}) for i in range(40)
+    ]
+    r = solve(pods, its=fake.instance_types(8))
+    assert all(scheduled(r, f"p{i}") for i in range(40))
+    assert len(r.new_node_claims) >= 2
+
+
+def test_bp_small_and_large_pack_together():
+    """suite_test.go:1606 — mixed sizes fill large instances."""
+    pods = [fixtures.pod(name=f"s{i}", requests={"cpu": "100m"}) for i in range(10)]
+    pods += [fixtures.pod(name=f"l{i}", requests={"cpu": "4"}) for i in range(2)]
+    r = solve(pods, its=fake.instance_types(8))
+    assert all(scheduled(r, p.name) for p in pods)
+
+
+def test_bp_zero_quantity_requests():
+    """suite_test.go:1664 — zero-valued requests schedule fine."""
+    r = solve([fixtures.pod(name="p", requests={"cpu": "0"})])
+    assert scheduled(r, "p")
+
+
+def test_bp_exceeding_every_type_fails():
+    """suite_test.go:1676 — request larger than every type's capacity."""
+    r = solve(
+        [fixtures.pod(name="p", requests={"cpu": "1000"})],
+        its=fake.instance_types(8),
+    )
+    assert not scheduled(r, "p")
+
+
+def test_bp_pods_per_node_limit_forces_new_nodes():
+    """suite_test.go:1687 — the single-pod type takes one pod each."""
+    pods = [
+        fixtures.pod(
+            name=f"p{i}",
+            node_selector={ITYPE: "single-pod-instance-type"},
+        )
+        for i in range(3)
+    ]
+    r = solve(pods)
+    claims = {id(claim_of(r, f"p{i}")) for i in range(3)}
+    assert None not in claims and len(claims) == 3
+
+
+# ---------------------------------------------------------------------------
+# NodePool requirements instance filtering (suite_test.go:4612-4752)
+
+
+def test_filtering_no_instance_types_pod_error():
+    """suite_test.go:4613 — pool requirements eliminate every type; the
+    pod error must say so."""
+    pool = fixtures.node_pool(
+        name="default",
+        requirements=[
+            NodeSelectorRequirement(ITYPE, Operator.IN, ["nonexistent-type"])
+        ],
+    )
+    r = solve([fixtures.pod(name="p")], pools=[pool])
+    assert not scheduled(r, "p")
+    assert r.pod_errors
+
+
+def test_filtering_conflicting_requirements_all_pods_fail():
+    """suite_test.go:4660/4693 — several pods, same empty universe."""
+    pool = fixtures.node_pool(
+        name="default",
+        requirements=[
+            NodeSelectorRequirement(ARCH, Operator.IN, ["amd64"]),
+            NodeSelectorRequirement(ARCH, Operator.NOT_IN, ["amd64"]),
+        ],
+    )
+    r = solve([fixtures.pod(name=f"p{i}") for i in range(3)], pools=[pool])
+    assert all(not scheduled(r, f"p{i}") for i in range(3))
+
+
+def test_filtering_zone_requirements_empty_universe():
+    """suite_test.go:4726 — a zone no offering covers filters all types."""
+    pool = fixtures.node_pool(
+        name="default",
+        requirements=[NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-9"])],
+    )
+    r = solve([fixtures.pod(name="p")], pools=[pool])
+    assert not scheduled(r, "p")
+
+
+# ---------------------------------------------------------------------------
+# kernel-parity tail: the same families through the TPU path
+
+
+def test_reference_families_kernel_parity():
+    """A mixed batch drawn from the families above, solved oracle AND
+    kernel — placements must agree (the repo's standing parity bar)."""
+    pods = [
+        fixtures.pod(name="u1"),
+        fixtures.pod(
+            name="z3",
+            node_requirements=[
+                NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-3"])
+            ],
+        ),
+        fixtures.pod(
+            name="ni",
+            node_requirements=[
+                NodeSelectorRequirement(
+                    ZONE, Operator.NOT_IN, ["test-zone-1", "unknown"]
+                )
+            ],
+        ),
+        fixtures.pod(
+            name="pref",
+            node_requirements=[
+                NodeSelectorRequirement(
+                    ZONE, Operator.IN, ["test-zone-1", "test-zone-2"]
+                )
+            ],
+            node_preferences=[
+                NodeSelectorRequirement(ZONE, Operator.IN, ["test-zone-2"])
+            ],
+        ),
+        fixtures.pod(name="exists", node_requirements=[
+            NodeSelectorRequirement(ZONE, Operator.EXISTS)
+        ]),
+        fixtures.pod(name="fail", node_selector={"undefined-key": "v"}),
+    ]
+
+    def snapshot(r):
+        out = {}
+        for pod in pods:
+            c = claim_of(r, pod.name)
+            out[pod.name] = (
+                None if c is None else (claim_value(c, ZONE), tuple(sorted(type_names(c))))
+            )
+        return out
+
+    import copy
+
+    r_oracle = solve(copy.deepcopy(pods))
+    r_kernel = solve(copy.deepcopy(pods), kernel=True)
+    assert snapshot(r_oracle) == snapshot(r_kernel)

@@ -1,0 +1,450 @@
+"""NodePool validation — the CRD CEL rule table absorbed into runtime
+checks (reference nodepool.go markers + nodepool_validation.go:28
+RuntimeValidate). Scenario families mirror
+/root/reference/pkg/apis/v1/nodepool_validation_cel_test.go.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api.objects import (
+    Budget,
+    NodeSelectorRequirement,
+    Operator,
+    Taint,
+    TaintEffect,
+)
+from karpenter_tpu.controllers.nodepool_aux import NodePoolValidation
+from karpenter_tpu.testing import fixtures
+
+
+def ok(np):
+    assert NodePoolValidation.validate(np) is None
+
+
+def bad(np, fragment: str = ""):
+    err = NodePoolValidation.validate(np)
+    assert err is not None, "expected a validation error"
+    if fragment:
+        assert fragment in err, err
+
+
+# -- budgets (cel_test.go:149-260) ------------------------------------------
+
+
+def test_budget_valid_shapes():
+    ok(fixtures.node_pool(budgets=[Budget(nodes="10")]))
+    ok(fixtures.node_pool(budgets=[Budget(nodes="100%")]))
+    ok(fixtures.node_pool(budgets=[Budget(nodes="0")]))
+    # both schedule and duration
+    ok(
+        fixtures.node_pool(
+            budgets=[
+                Budget(nodes="10", schedule="* * * * *", duration_seconds=3600)
+            ]
+        )
+    )
+    # hours and minutes in duration
+    ok(
+        fixtures.node_pool(
+            budgets=[
+                Budget(
+                    nodes="10", schedule="@daily", duration_seconds=2 * 3600 + 300
+                )
+            ]
+        )
+    )
+    # neither
+    ok(fixtures.node_pool(budgets=[Budget(nodes="10")]))
+    # special-cased crons
+    for special in ("@annually", "@yearly", "@monthly", "@weekly", "@daily",
+                    "@midnight", "@hourly"):
+        ok(
+            fixtures.node_pool(
+                budgets=[
+                    Budget(nodes="10", schedule=special, duration_seconds=60)
+                ]
+            )
+        )
+
+
+def test_budget_invalid_cron():
+    bad(
+        fixtures.node_pool(
+            budgets=[Budget(nodes="10", schedule="*", duration_seconds=60)]
+        ),
+        "schedule",
+    )
+    bad(
+        fixtures.node_pool(
+            budgets=[
+                Budget(nodes="10", schedule="* * * *", duration_seconds=60)
+            ]
+        ),
+        "schedule",
+    )
+    bad(
+        fixtures.node_pool(
+            budgets=[
+                Budget(nodes="10", schedule="@crazy", duration_seconds=60)
+            ]
+        ),
+        "schedule",
+    )
+
+
+def test_budget_duration_rules():
+    # negative duration
+    bad(
+        fixtures.node_pool(
+            budgets=[
+                Budget(nodes="10", schedule="* * * * *", duration_seconds=-60)
+            ]
+        ),
+        "duration",
+    )
+    # seconds granularity (CRD pattern admits h/m only)
+    bad(
+        fixtures.node_pool(
+            budgets=[
+                Budget(nodes="10", schedule="* * * * *", duration_seconds=30)
+            ]
+        ),
+        "duration",
+    )
+
+
+def test_budget_nodes_value_rules():
+    bad(fixtures.node_pool(budgets=[Budget(nodes="-1")]), "nodes")
+    bad(fixtures.node_pool(budgets=[Budget(nodes="-10%")]), "nodes")
+    bad(fixtures.node_pool(budgets=[Budget(nodes="101%")]), "nodes")
+    bad(fixtures.node_pool(budgets=[Budget(nodes="1000%")]), "nodes")
+    bad(fixtures.node_pool(budgets=[Budget(nodes="five")]), "nodes")
+
+
+def test_budget_schedule_requires_duration_and_vice_versa():
+    bad(
+        fixtures.node_pool(
+            budgets=[Budget(nodes="10", schedule="* * * * *")]
+        ),
+        "'schedule' must be set with 'duration'",
+    )
+    bad(
+        fixtures.node_pool(budgets=[Budget(nodes="10", duration_seconds=60)]),
+        "'schedule' must be set with 'duration'",
+    )
+
+
+def test_one_bad_budget_among_many_fails():
+    bad(
+        fixtures.node_pool(
+            budgets=[
+                Budget(nodes="10"),
+                Budget(nodes="10", schedule="@invalid", duration_seconds=60),
+            ]
+        )
+    )
+
+
+# -- taints (cel_test.go:313-377) -------------------------------------------
+
+
+def test_taint_valid():
+    ok(
+        fixtures.node_pool(
+            taints=[
+                Taint(key="a", effect=TaintEffect.NO_SCHEDULE),
+                Taint(key="dev/a", value="v", effect=TaintEffect.NO_EXECUTE),
+                Taint(key="a.b.c/d-e_f", effect=TaintEffect.PREFER_NO_SCHEDULE),
+            ]
+        )
+    )
+    # same key, different effects (cel_test.go:369)
+    ok(
+        fixtures.node_pool(
+            taints=[
+                Taint(key="a", effect=TaintEffect.NO_SCHEDULE),
+                Taint(key="a", effect=TaintEffect.NO_EXECUTE),
+            ]
+        )
+    )
+
+
+def test_taint_invalid_keys_and_values():
+    bad(
+        fixtures.node_pool(
+            taints=[Taint(key="???", effect=TaintEffect.NO_SCHEDULE)]
+        ),
+        "taint key",
+    )
+    bad(
+        fixtures.node_pool(
+            taints=[Taint(key="", effect=TaintEffect.NO_SCHEDULE)]
+        ),
+        "required",
+    )
+    bad(
+        fixtures.node_pool(
+            taints=[Taint(key="a" * 64, effect=TaintEffect.NO_SCHEDULE)]
+        ),
+        "taint key",
+    )
+    bad(
+        fixtures.node_pool(
+            taints=[Taint(key="ok", value="bad value!", effect=TaintEffect.NO_SCHEDULE)]
+        ),
+        "taint value",
+    )
+    # startup taints run the same rules
+    bad(
+        fixtures.node_pool(
+            startup_taints=[Taint(key="???", effect=TaintEffect.NO_SCHEDULE)]
+        ),
+        "taint key",
+    )
+
+
+# -- requirements (cel_test.go:379-553) --------------------------------------
+
+
+def _np_req(*reqs):
+    return fixtures.node_pool(requirements=list(reqs))
+
+
+def test_requirement_valid_keys_and_ops():
+    ok(
+        _np_req(
+            NodeSelectorRequirement("custom-key", Operator.IN, ["a"]),
+            NodeSelectorRequirement("dev.example.com/key", Operator.NOT_IN, ["b"]),
+            NodeSelectorRequirement("exists-key", Operator.EXISTS),
+            NodeSelectorRequirement("absent-key", Operator.DOES_NOT_EXIST),
+            NodeSelectorRequirement("gt-key", Operator.GT, ["5"]),
+            NodeSelectorRequirement("lt-key", Operator.LT, ["0"]),
+        )
+    )
+
+
+def test_requirement_invalid_keys():
+    bad(_np_req(NodeSelectorRequirement("???", Operator.EXISTS)), "qualified name")
+    bad(
+        _np_req(NodeSelectorRequirement("a" * 64, Operator.EXISTS)),
+        "qualified name",
+    )
+    bad(
+        _np_req(
+            NodeSelectorRequirement("karpenter.sh/nodepool", Operator.IN, ["x"])
+        ),
+        "restricted",
+    )
+
+
+def test_requirement_restricted_domains():
+    bad(
+        _np_req(
+            NodeSelectorRequirement("kubernetes.io/custom", Operator.EXISTS)
+        ),
+        "restricted",
+    )
+    bad(
+        _np_req(NodeSelectorRequirement("k8s.io/custom", Operator.EXISTS)),
+        "restricted",
+    )
+    bad(
+        _np_req(
+            NodeSelectorRequirement("sub.kubernetes.io/custom", Operator.EXISTS)
+        ),
+        "restricted",
+    )
+    # exceptions (cel_test.go:452-487)
+    ok(_np_req(NodeSelectorRequirement("kops.k8s.io/custom", Operator.EXISTS)))
+    ok(
+        _np_req(
+            NodeSelectorRequirement(
+                "node-restriction.kubernetes.io/custom", Operator.EXISTS
+            )
+        )
+    )
+    # well-known labels inside restricted domains are allowed
+    ok(
+        _np_req(
+            NodeSelectorRequirement(
+                "topology.kubernetes.io/zone", Operator.IN, ["z1"]
+            )
+        )
+    )
+
+
+def test_requirement_in_needs_values():
+    bad(
+        _np_req(NodeSelectorRequirement("key", Operator.IN, [])),
+        "operator 'In' must have a value defined",
+    )
+
+
+def test_requirement_gt_lt_values():
+    for vals in ([], ["1", "2"], ["notanum"]):
+        bad(
+            _np_req(NodeSelectorRequirement("key", Operator.GT, vals)),
+            "single positive integer",
+        )
+        bad(
+            _np_req(NodeSelectorRequirement("key", Operator.LT, vals)),
+            "single positive integer",
+        )
+    # "-1" fails label-value validation first (the reference's multierr
+    # reports both; the first error wins here)
+    bad(_np_req(NodeSelectorRequirement("key", Operator.GT, ["-1"])))
+    bad(_np_req(NodeSelectorRequirement("key", Operator.LT, ["-1"])))
+
+
+def test_requirement_min_values_bounds():
+    bad(
+        _np_req(
+            NodeSelectorRequirement("key", Operator.IN, ["a"], min_values=-1)
+        ),
+        "minValues",
+    )
+    bad(
+        _np_req(
+            NodeSelectorRequirement("key", Operator.IN, ["a"], min_values=0)
+        ),
+        "minValues",
+    )
+    bad(
+        _np_req(
+            NodeSelectorRequirement(
+                "key", Operator.IN, [str(i) for i in range(60)], min_values=51
+            )
+        ),
+        "minValues",
+    )
+    # more values than 50 is fine without minValues (cel_test.go:536)
+    ok(
+        _np_req(
+            NodeSelectorRequirement(
+                "key", Operator.IN, [str(i) for i in range(60)]
+            )
+        )
+    )
+    # raw length counts (no dedup — nodeclaim_validation.go:142); three
+    # values with duplicates still satisfy minValues=3
+    ok(
+        _np_req(
+            NodeSelectorRequirement(
+                "key", Operator.IN, ["a", "b", "a"], min_values=3
+            )
+        )
+    )
+    bad(
+        _np_req(
+            NodeSelectorRequirement("key", Operator.IN, ["a", "b"], min_values=3)
+        ),
+        "at least that many values",
+    )
+    ok(
+        _np_req(
+            NodeSelectorRequirement("key", Operator.IN, ["a", "b"], min_values=2)
+        )
+    )
+
+
+def test_requirement_count_cap():
+    reqs = [
+        NodeSelectorRequirement(f"key-{i}", Operator.EXISTS) for i in range(101)
+    ]
+    bad(fixtures.node_pool(requirements=reqs), "100")
+
+
+# -- template labels (cel_test.go:554-647) -----------------------------------
+
+
+def test_labels_rules():
+    ok(fixtures.node_pool(labels={"custom": "v", "dev.example.com/x": "y"}))
+    bad(
+        fixtures.node_pool(labels={"karpenter.sh/nodepool": "x"}), "restricted"
+    )
+    bad(fixtures.node_pool(labels={"???": "v"}), "labels")
+    bad(fixtures.node_pool(labels={"ok": "bad value!"}), "label")
+    bad(fixtures.node_pool(labels={"kubernetes.io/custom": "v"}), "restricted")
+    # exceptions
+    ok(fixtures.node_pool(labels={"kops.k8s.io/x": "v"}))
+    ok(fixtures.node_pool(labels={"node-restriction.kubernetes.io/x": "v"}))
+    ok(fixtures.node_pool(labels={"topology.kubernetes.io/zone": "z1"}))
+    # too-long key
+    bad(fixtures.node_pool(labels={"a" * 64: "v"}), "labels")
+
+
+# -- scalar/static fields ----------------------------------------------------
+
+
+def test_weight_and_replicas_rules():
+    ok(fixtures.node_pool(weight=1))
+    ok(fixtures.node_pool(weight=100))
+    bad(fixtures.node_pool(weight=101), "weight")
+    np = fixtures.node_pool(replicas=3)
+    ok(np)
+    np = fixtures.node_pool(replicas=3, weight=5)
+    bad(np, "static")
+    np = fixtures.node_pool(replicas=3, limits={"cpu": "100"})
+    bad(np, "limits.nodes")
+    np = fixtures.node_pool(replicas=3, limits={"nodes": "5"})
+    ok(np)
+    np = fixtures.node_pool(replicas=-1)
+    bad(np, "replicas")
+
+
+def test_consolidate_after_non_negative():
+    np = fixtures.node_pool()
+    np.disruption.consolidate_after_seconds = -1
+    bad(np, "consolidateAfter")
+
+
+def test_budget_name_based_cron_accepted():
+    """Name-based cron fields are valid (the reference CRD pattern is
+    permissive; robfig cron accepts MON-FRI at parse time)."""
+    ok(
+        fixtures.node_pool(
+            budgets=[
+                Budget(
+                    nodes="10", schedule="0 9 * * MON-FRI", duration_seconds=3600
+                )
+            ]
+        )
+    )
+
+
+def test_requirement_min_values_counts_raw_length_and_known_values():
+    """nodeclaim_validation.go:142 compares raw len(values) — duplicates
+    count; validateWellKnownValues:187 requires minValues VALID values for
+    keys with a known universe."""
+    # duplicates count toward minValues (no dedup in the reference)
+    ok(
+        _np_req(
+            NodeSelectorRequirement("key", Operator.IN, ["a", "a"], min_values=2)
+        )
+    )
+    # capacity-type: enough raw values but too few KNOWN ones
+    from karpenter_tpu.api import labels as well_known
+
+    bad(
+        _np_req(
+            NodeSelectorRequirement(
+                well_known.CAPACITY_TYPE_LABEL_KEY,
+                Operator.IN,
+                ["spot", "bogus1", "bogus2"],
+                min_values=2,
+            )
+        ),
+        "valid values",
+    )
+    ok(
+        _np_req(
+            NodeSelectorRequirement(
+                well_known.CAPACITY_TYPE_LABEL_KEY,
+                Operator.IN,
+                ["spot", "on-demand", "bogus"],
+                min_values=2,
+            )
+        )
+    )
