@@ -79,9 +79,14 @@ class ConsolidationBase:
             return False
         return c.consolidatable()
 
+    # graceful methods always respect blocking PDBs / do-not-disrupt;
+    # eventual methods override (types.go:47-48)
+    disruption_class = "graceful"
+
     def candidates(self) -> list[Candidate]:
         out = build_candidates(
-            self.kube, self.cluster, self.cloud, self.clock, self.should_disrupt
+            self.kube, self.cluster, self.cloud, self.clock,
+            self.should_disrupt, disruption_class=self.disruption_class,
         )
         # consolidation.go:127 sortCandidates: cheapest disruption first
         out.sort(key=lambda c: (c.disruption_cost, c.name))
@@ -186,9 +191,12 @@ class EmptinessConsolidation(ConsolidationBase):
 
 class DriftConsolidation(ConsolidationBase):
     """drift.go:38 Drift: replace drifted nodes, budget-gated, one at a
-    time in drift-condition order."""
+    time in drift-condition order. Drift is an EVENTUAL disruption method
+    (drift.go:111): a TerminationGracePeriod on the claim lets it proceed
+    past do-not-disrupt pods and blocking PDBs."""
 
     reason = REASON_DRIFTED
+    disruption_class = "eventual"
 
     def should_disrupt(self, c: Candidate) -> bool:
         return not c.owned_by_static_nodepool() and c.drifted()  # drift.go:56

@@ -150,8 +150,15 @@ def build_candidates(
     cloud_provider,
     clock,
     should_disrupt: Callable[[Candidate], bool],
+    disruption_class: str = "graceful",
 ) -> list[Candidate]:
-    """GetCandidates with pods/prices resolved (the working entry point)."""
+    """GetCandidates with pods/prices resolved (the working entry point).
+
+    disruption_class (types.go:47-48 + types.go:118): GRACEFUL methods
+    (consolidation, emptiness) always respect blocking PDBs and the
+    do-not-disrupt annotation; EVENTUAL methods (drift, static drift)
+    on a claim with a TerminationGracePeriod may disrupt anyway — the TGP
+    bounds how long those pods can hold the node."""
     nodepools = {np.name: np for np in kube.list("NodePool")}
     pdb_limits = PDBLimits.from_kube(kube)
     its_cache: dict[str, dict[str, object]] = {}
@@ -162,8 +169,13 @@ def build_candidates(
         if c is None:
             continue
         pods = cluster.pods_on(sn.name)
+        tgp_eventual = (
+            disruption_class == "eventual"
+            and sn.node_claim is not None
+            and sn.node_claim.termination_grace_period_seconds is not None
+        )
         # pods blocking disruption entirely (statenode.go:234): do-not-disrupt
-        if any(
+        if not tgp_eventual and any(
             p.metadata.annotations.get(well_known.DO_NOT_DISRUPT_ANNOTATION_KEY)
             == "true"
             for p in pods
@@ -171,15 +183,18 @@ def build_candidates(
             continue
         # PDB check: every evictable pod must be currently evictable
         blocked = False
-        for p in pods:
-            ok, _ = pdb_limits.can_evict(p)
-            if not ok or pdb_limits.is_fully_blocked(p) is not None:
-                blocked = True
-                break
+        if not tgp_eventual:
+            for p in pods:
+                ok, _ = pdb_limits.can_evict(p)
+                if not ok or pdb_limits.is_fully_blocked(p) is not None:
+                    blocked = True
+                    break
         if blocked:
             continue
         c.reschedulable_pods = [p for p in pods if is_reschedulable(p)]
-        c.disruption_cost = disruption_cost(c.reschedulable_pods)
+        c.disruption_cost = disruption_cost(
+            c.reschedulable_pods, clock, c.state_node.node_claim
+        )
         c.price = _candidate_price(c, cloud_provider, its_cache)
         if should_disrupt(c):
             out.append(c)

@@ -55,7 +55,8 @@ class StaticDrift:
 
     def compute_commands(self) -> list[Command]:
         candidates = build_candidates(
-            self.kube, self.cluster, self.cloud, self.clock, self.should_disrupt
+            self.kube, self.cluster, self.cloud, self.clock,
+            self.should_disrupt, disruption_class="eventual",  # staticdrift.go:112
         )
         if not candidates:
             return []

@@ -105,14 +105,42 @@ class Command:
         )
 
 
-def disruption_cost(pods: list[Pod], clock=None) -> float:
-    """disruptionCost (reference disruption/helpers.go:300-320): pods with
-    higher priority and explicit do-not-disrupt preferences cost more to
-    move; the reference also scales by remaining pod lifetime."""
-    cost = 0.0
-    for p in pods:
-        cost += 1.0
-        cost += p.priority / 1e6
-        if p.metadata.annotations.get(well_known.DO_NOT_DISRUPT_ANNOTATION_KEY) == "true":
-            cost += 10.0
+POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
+
+
+def eviction_cost(pod: Pod) -> float:
+    """utils/disruption/disruption.go:49 EvictionCost, exactly: base 1.0 +
+    deletion-cost annotation / 2^27 + priority / 2^25, clamped to
+    [-10, 10]. A malformed annotation is ignored (the reference logs and
+    continues)."""
+    cost = 1.0
+    raw = pod.metadata.annotations.get(POD_DELETION_COST_ANNOTATION)
+    if raw is not None:
+        try:
+            cost += float(raw) / (2.0 ** 27)
+        except ValueError:
+            pass
+    cost += float(pod.priority) / (2.0 ** 25)
+    return max(-10.0, min(10.0, cost))
+
+
+def lifetime_remaining(clock, claim) -> float:
+    """utils/disruption/disruption.go:37 LifetimeRemaining: fraction of
+    expireAfter left, in [0, 1]; 1.0 when expiry is disabled — nodes near
+    expiry are cheaper to disrupt."""
+    if claim is None or claim.expire_after_seconds is None:
+        return 1.0
+    total = float(claim.expire_after_seconds)
+    if total <= 0:
+        return 1.0
+    age = clock.now() - claim.metadata.creation_timestamp
+    return max(0.0, min(1.0, (total - age) / total))
+
+
+def disruption_cost(pods: list[Pod], clock=None, claim=None) -> float:
+    """ReschedulingCost x LifetimeRemaining (disruption.go:72 +
+    types.go:132): the candidate-ordering key."""
+    cost = sum(eviction_cost(p) for p in pods)
+    if clock is not None:
+        cost *= lifetime_remaining(clock, claim)
     return cost

@@ -58,7 +58,7 @@ class Options:
     preference_policy: str = "Respect"  # Respect | Ignore
     min_values_policy: str = "Strict"  # Strict | BestEffort
     solve_timeout_seconds: float = 60.0  # provisioner.go:366
-    tpu_claim_slot_div: int = 4  # SchedulerOptions.claim_slot_div
+    tpu_claim_slot_div: int = 16  # SchedulerOptions.claim_slot_div
     tpu_min_pods: int = 768  # SchedulerOptions.tpu_min_pods (0 disables routing)
     # disruption
     disruption_poll_seconds: float = 10.0  # disruption/controller.go:69

@@ -185,8 +185,10 @@ class SchedulerOptions:
     timeout_seconds: Optional[float] = None  # Solve budget (provisioner.go:366)
     # TPU solver: initial claim-slot pool = pods/claim_slot_div (pow2-
     # bucketed, grows on kernel overflow). Smaller pools cut per-step
-    # candidate screens; too small forces an overflow re-solve.
-    claim_slot_div: int = 4
+    # candidate screens AND the decode fetch; the runs kernel pads the
+    # carried state and CONTINUES on overflow (decisions are N-invariant),
+    # so undersizing costs one growth event, not a re-solve.
+    claim_slot_div: int = 16
     # Hybrid routing: batches below this size with NO topology groups run
     # on the oracle — the device launch/tunnel floor (~0.7s) beats the
     # oracle only above the crossover. Measured on the tunneled v5e

@@ -184,6 +184,39 @@ def _run_arrays(cls_d, bulk_c, aff_c, idx, n):
     return _run_arrays_cached(cls_d, bulk_c, aff_c, idx, n)
 
 
+_grow_state_cached = None
+
+
+def _grow_state(st, seq, pad):
+    """Append inert claim-slot rows to the carried State + seq (overflow
+    continuation: slot count only gates claim creation, so decisions made
+    at the smaller N are unchanged — the host pads and resumes instead of
+    re-solving). `pad` is a host-built tuple of pad blocks."""
+    global _grow_state_cached
+    if _grow_state_cached is None:
+        import jax
+
+        def impl(st, seq, pad):
+            import jax.numpy as jnp
+
+            (pcreq, pactive, pints, pcrequests, palive, pcmax, pseq, ph) = pad
+            cat = lambda a, b: jnp.concatenate([a, b], axis=0)
+            return st._replace(
+                active=cat(st.active, pactive),
+                count=cat(st.count, pints),
+                rank=cat(st.rank, pints),
+                tmpl=cat(st.tmpl, pints),
+                creq=Reqs(*(cat(a, b) for a, b in zip(st.creq, pcreq))),
+                crequests=cat(st.crequests, pcrequests),
+                alive=cat(st.alive, palive),
+                cmax_alloc=cat(st.cmax_alloc, pcmax),
+                h_cnt=jnp.concatenate([st.h_cnt, ph], axis=1),
+            ), cat(seq, pseq)
+
+        _grow_state_cached = jax.jit(impl)
+    return _grow_state_cached(st, seq, pad)
+
+
 _slice_decode_cached = None
 
 
@@ -457,10 +490,18 @@ class TpuScheduler:
             )
 
         # Claim slots: most solves create far fewer claims than pods (the
-        # bench mix averages ~5 pods/claim), so start small and grow on the
-        # kernel's overflow signal — smaller N cuts every per-step candidate
-        # screen. Worst case (one pod per claim) ends at _pow2(len(pods)).
+        # bench mix averages ~5 pods/claim), so start small — every
+        # per-step candidate screen and the decode fetch scale with N. On
+        # the kernel's overflow signal the runs path PADS the carried
+        # state and continues from the overflow pod (decisions are
+        # N-invariant: slot count only gates claim creation), so a small
+        # start risks only a cheap growth event, not a re-solve. The scan
+        # path (no early stop inside lax.scan) re-solves from scratch.
         div = max(1, int(self.opts.claim_slot_div))
+        if not use_runs:
+            # the scan path can't stop mid-batch (lax.scan), so overflow
+            # means a full re-solve — don't undersize its slot pool
+            div = min(div, 4)
         N = min(_pow2(max(64, (len(pods) + div - 1) // div)), _pow2(len(pods)))
         while True:
             st = self._init_state(problem, N)
@@ -475,45 +516,80 @@ class TpuScheduler:
                 if deadline is not None and time_mod.monotonic() > deadline:
                     timed_out = True
                     break
-                if use_runs:
-                    with prof.phase("pod_xs"):
-                        xs, idx_d, n_d = self._pod_xs_with_idx(problem, pending)
-                        rx = self._run_x(xs, idx_d, n_d)
-                    with prof.phase("kernel"):
-                        st, seq, next_seq, got_kinds, got_slots, got_over, iters = (
-                            KR.solve_runs(
+                # one requeue round over `pending` (scheduler.go:380); the
+                # runs path may take several kernel launches per round when
+                # an overflow growth lands mid-batch
+                round_failed: list[int] = []
+                offset = 0
+                while True:
+                    batch = pending[offset:]
+                    if use_runs:
+                        with prof.phase("pod_xs"):
+                            xs, idx_d, n_d = self._pod_xs_with_idx(problem, batch)
+                            rx = self._run_x(xs, idx_d, n_d)
+                        with prof.phase("kernel"):
+                            (
+                                st, seq, next_seq, got_kinds, got_slots,
+                                got_over, iters, got_ptr,
+                            ) = KR.solve_runs(
                                 tb, st, rx, seq, next_seq,
-                                jax.numpy.int32(len(pending)),
+                                jax.numpy.int32(len(batch)),
                                 relax=relax,
                             )
+                        self.last_iters = iters
+                    else:
+                        with prof.phase("pod_xs"):
+                            xs = self._pod_xs(problem, batch)
+                        with prof.phase("kernel"):
+                            st, got_kinds, got_slots, got_over = K.solve_scan(
+                                tb, st, xs, relax=relax
+                            )
+                            got_ptr = None
+                    # one batched device->host fetch (the tunnel charges
+                    # per call)
+                    with prof.phase("fetch"):
+                        fetched = jax.device_get(
+                            (got_kinds, got_slots, got_over)
+                            if got_ptr is None
+                            else (got_kinds, got_slots, got_over, got_ptr)
                         )
-                    self.last_iters = iters
-                else:
-                    with prof.phase("pod_xs"):
-                        xs = self._pod_xs(problem, pending)
-                    with prof.phase("kernel"):
-                        st, got_kinds, got_slots, got_over = K.solve_scan(
-                            tb, st, xs, relax=relax
-                        )
-                # one batched device->host fetch (the tunnel charges per call)
-                with prof.phase("fetch"):
-                    got_kinds, got_slots, got_over = jax.device_get(
-                        (got_kinds, got_slots, got_over)
-                    )
-                if bool(got_over):
-                    overflowed = True
+                    got_kinds, got_slots, got_over = fetched[:3]
+                    if bool(got_over) and got_ptr is None:
+                        overflowed = True  # scan path: re-solve from scratch
+                        break
+                    if bool(got_over):
+                        # runs path: commit everything before the overflow
+                        # pod, pad the state with fresh slots, continue the
+                        # round from that pod
+                        n_done = int(fetched[3])
+                        done = batch[:n_done]
+                        kinds[done] = got_kinds[:n_done]
+                        slots[done] = got_slots[:n_done]
+                        round_failed += [
+                            i for i, k in zip(done, got_kinds[:n_done])
+                            if k == K.KIND_FAIL
+                        ]
+                        with prof.phase("upload"):
+                            st, seq = self._grow(problem, st, seq, N)
+                        N *= 2
+                        offset += n_done
+                        continue
+                    got_kinds = got_kinds[: len(batch)]
+                    got_slots = got_slots[: len(batch)]
+                    kinds[batch] = got_kinds
+                    slots[batch] = got_slots
+                    round_failed += [
+                        i for i, k in zip(batch, got_kinds) if k == K.KIND_FAIL
+                    ]
                     break
-                got_kinds = got_kinds[: len(pending)]
-                got_slots = got_slots[: len(pending)]
-                kinds[pending] = got_kinds
-                slots[pending] = got_slots
-                failed = [i for i, k in zip(pending, got_kinds) if k == K.KIND_FAIL]
-                if len(failed) == len(pending):
+                if overflowed:
+                    break
+                if len(round_failed) == len(pending):
                     break  # no progress: stall (queue.go:52)
-                pending = failed
+                pending = round_failed
             if not overflowed:
                 break
-            N *= 2  # slots exhausted: re-solve from scratch with room
+            N *= 2  # scan-path slots exhausted: re-solve with room
 
         with prof.phase("decode"):
             return self._decode(problem, st, kinds, slots, timed_out)
@@ -719,6 +795,29 @@ class TpuScheduler:
             v_cnt=jnp.asarray(v_cnt),
             h_cnt=jnp.asarray(h_cnt),
         )
+
+    def _grow(self, p: EncodedProblem, st, seq, N: int):
+        """Pad the carried device state from N to 2N claim slots (overflow
+        continuation). Pad rows replicate _init_state's inert slots."""
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.encode import empty_reqs
+
+        vocab, table = p.vocab, p.table
+        R = table.num_resources
+        IW = max(1, (p.num_types + 31) // 32)
+        Gh = st.h_cnt.shape[0]
+        pad = (
+            Reqs(*(jnp.asarray(a) for a in empty_reqs(vocab, (N,)))),
+            jnp.zeros(N, bool),
+            jnp.zeros(N, jnp.int32),
+            jnp.zeros((N, R), jnp.int32),
+            jnp.zeros((N, IW), jnp.uint32),
+            jnp.zeros((N, R), jnp.int32),
+            jnp.zeros(N, jnp.int32),
+            jnp.zeros((Gh, N), jnp.int32),
+        )
+        return _grow_state(st, seq, pad)
 
     def _upload_pod_tables(self, p: EncodedProblem) -> None:
         """Ship pod tables to the device once per solve; per-round pod

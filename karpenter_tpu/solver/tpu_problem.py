@@ -711,6 +711,7 @@ def _class_pass(
     sel_cache: dict[tuple, int] = {}
     rows_v: list[list[bool]] = []
     rows_h: list[list[bool]] = []
+    inv_keys: list[tuple] = []  # per srow: inverse-selection tuple
     class_map: dict[tuple, int] = {}
     rkey_map: dict[bytes, int] = {}
     cls = [0] * P
@@ -719,6 +720,16 @@ def _class_pass(
     rcls_of: list[int] = []
     inv_rows: list[tuple] = []  # per class, over inverse groups
     own_rows: list[tuple] = []
+    # inverse OWNERSHIP is per-uid: invert the owner sets once instead of
+    # scanning every inverse group per pod (the per-pod tuple builds were
+    # ~half of encode wall-clock at 50k pods)
+    owners_rev: dict[str, tuple[int, ...]] = {}
+    if inv_tgs:
+        tmp: dict[str, list[int]] = {}
+        for k, tg in enumerate(inv_tgs):
+            for uid in tg.owners:
+                tmp.setdefault(uid, []).append(k)
+        owners_rev = {u: tuple(ks) for u, ks in tmp.items()}
     for i, pod in enumerate(pods):
         labels = pod.metadata.labels
         skey = (pod.namespace, tuple(sorted(labels.items())) if labels else ())
@@ -727,27 +738,28 @@ def _class_pass(
             s = len(rows_v)
             sel_cache[skey] = s
             rows_v.append([tg.selects(pod) for tg in v_tgs])
-            rows_h.append([tg.selects(pod) for tg in h_tgs])
+            hrow = [tg.selects(pod) for tg in h_tgs]
+            rows_h.append(hrow)
+            # inverse groups act as anti-affinity on any pod they select
+            # (topology.go:528) — selection is label-based, so the row is
+            # a per-srow fact
+            inv_keys.append(tuple(hrow[inv_start:]))
         srow[i] = s
         rkey = pod_class_repr(pod)
         rq = pod.requests
         qkey = tuple(sorted(rq.items())) if rq else ()
         if inv_tgs:
-            # inverse groups act as anti-affinity on any pod they select
-            # (topology.go:528) and record for their owners
-            hrow = rows_h[s]
-            inv_t = tuple(hrow[inv_start + k] for k in range(len(inv_tgs)))
-            own_t = tuple(tg.is_owned_by(pod.uid) for tg in inv_tgs)
-            key = (rkey, qkey, inv_t, own_t)
+            own_t = owners_rev.get(pod.uid, ())
+            key = (rkey, qkey, inv_keys[s], own_t)
         else:
-            inv_t = own_t = ()
+            own_t = ()
             key = (rkey, qkey)
         c = class_map.get(key)
         if c is None:
             c = len(reps)
             class_map[key] = c
             reps.append(i)
-            inv_rows.append(inv_t)
+            inv_rows.append(inv_keys[s] if inv_tgs else ())
             own_rows.append(own_t)
             rid = rkey_map.get(rkey)
             if rid is None:
@@ -777,9 +789,11 @@ def _class_pass(
     p.pinv_h_c = np.zeros((NC, Gh), dtype=bool)
     p.pown_h_c = np.zeros((NC, Gh), dtype=bool)
     for c in range(NC):
-        for k in range(Gh - inv_start):
-            p.pinv_h_c[c, inv_start + k] = inv_rows[c][k] if inv_rows[c] else False
-            p.pown_h_c[c, inv_start + k] = own_rows[c][k] if own_rows[c] else False
+        row = inv_rows[c]
+        if row:
+            p.pinv_h_c[c, inv_start:] = row
+        for k in own_rows[c]:  # owned inverse-group indices
+            p.pown_h_c[c, inv_start + k] = True
 
     # per-class Requirements, shared by vocab observation and encode.
     # PreferencePolicy=Ignore drops preferred terms up front

@@ -367,7 +367,14 @@ def solve_runs(
             lambda: _build_cache(tb, st2, x),
             lambda: rc._replace(active=jnp.zeros((), bool)),
         )
-        return st2, rc, seq, nseq, ptr + 1, kinds, slots, over | oflow
+        # a slot-overflow pod is NOT decided: ptr stays on it so the host's
+        # continuation retries it against the grown state (advancing would
+        # conflate it with a real failure and the stall check could end the
+        # solve with the pod wrongly unschedulable)
+        return (
+            st2, rc, seq, nseq, ptr + (~oflow).astype(jnp.int32),
+            kinds, slots, over | oflow,
+        )
 
     # -- bulk phases ------------------------------------------------------
 
@@ -445,16 +452,25 @@ def solve_runs(
             solo_units is set (then all window rows share tgt[0]). fis are
             the surviving-type bits per window row, computed once by the
             caller (they double as the exact-feasibility verify)."""
+            # windowed scatters, not whole-[N] adds: the loop carry's big
+            # arrays must only be written at touched rows or every bulk
+            # step pays a full-State rewrite
             if solo_units is None:
-                added = jnp.zeros(N, jnp.int32).at[tgt].add(pred.astype(jnp.int32))
+                padd = pred.astype(jnp.int32)
+                safe_t = jnp.where(pred, tgt, N)
+                crequests = st.crequests.at[safe_t].add(
+                    x.prequests[None, :].astype(jnp.int32)
+                )
+                count = st.count.at[safe_t].add(1)
                 seq2 = seq.at[tgt].max(jnp.where(pred, nseq + jW, -1))
                 nseq2 = nseq + kc
             else:
-                added = jnp.zeros(N, jnp.int32).at[tgt[0]].set(solo_units)
+                crequests = st.crequests.at[tgt[0]].add(
+                    solo_units * x.prequests
+                )
+                count = st.count.at[tgt[0]].add(solo_units)
                 seq2 = seq.at[tgt[0]].set(nseq + solo_units - 1)
                 nseq2 = nseq + solo_units
-            crequests = st.crequests + added[:, None] * x.prequests[None, :]
-            count = st.count + added
             creq = _set_rows(st.creq, tgt, finals, pred)
             packs = jax.vmap(lambda fi: _pack(fi, IW))(fis)
             cmaxs = jnp.max(
@@ -487,7 +503,10 @@ def solve_runs(
             pred = jW < k
             finals = _final_existing_rows(tb, st, x, tgt)
             added = jnp.zeros(E, jnp.int32).at[tgt].add(pred.astype(jnp.int32))
-            eavail = st.eavail - added[:, None] * x.prequests[None, :]
+            safe_e = jnp.where(pred, tgt, E)
+            eavail = st.eavail.at[safe_e].add(
+                -x.prequests[None, :].astype(jnp.int32)
+            )
             ereq = _set_rows(st.ereq, tgt, finals, pred)
             v_cnt, h_cnt = _record_window(
                 st, tb, finals, tgt, pred, selv, selh, ownh,
@@ -601,15 +620,14 @@ def solve_runs(
                 final_n = _row(rc.final_t, t)
                 pred = jW < f
                 cl_of = jnp.minimum(jW // cstar, N - 1 - 0)  # claim offset per pod
-                slot_of = jnp.where(pred, m + cl_of, N)  # OOB drops padding
+                # claims touched are the CONTIGUOUS window m..m+ncl-1; all
+                # writes below scatter through this [W]-sized index so no
+                # [N]-sized carry array is rewritten whole (a full-State
+                # rewrite per step dominated bulk-phase cost)
+                pred_c = jW < ncl  # claim lanes of the window
+                idx_c = jnp.where(pred_c, m + jW, N)  # OOB drops padding
                 # per-claim fill counts: full cstar except a partial last
-                fills = jnp.zeros(N + 1, jnp.int32).at[slot_of].add(1)[:N]
-                touched = fills > 0
-                crequests = jnp.where(
-                    touched[:, None],
-                    tb.tdaemon[t][None, :] + fills[:, None] * x.prequests[None, :],
-                    st.crequests,
-                )
+                fills_w = jnp.clip(f - jW * cstar, 0, cstar)  # [W]
                 alive_m = _unpack(rc.alive_t[t], I)
                 per = jnp.where(
                     alive_m,
@@ -635,27 +653,27 @@ def solve_runs(
                 cmax_last = jnp.max(
                     jnp.where(fi_last[:, None], tb.ialloc, -INF_I), axis=0
                 )
-                is_full = fills == cstar
-                alive = jnp.where(
-                    touched[:, None],
-                    jnp.where(is_full[:, None], pack_full[None], pack_last[None]),
-                    st.alive,
+                is_full_w = fills_w == cstar  # [W]
+                crequests = st.crequests.at[idx_c].set(
+                    tb.tdaemon[t][None, :]
+                    + fills_w[:, None] * x.prequests[None, :]
                 )
-                cmax_alloc = jnp.where(
-                    touched[:, None],
-                    jnp.where(is_full[:, None], cmax_full[None], cmax_last[None]),
-                    st.cmax_alloc,
+                alive = st.alive.at[idx_c].set(
+                    jnp.where(is_full_w[:, None], pack_full[None], pack_last[None])
                 )
-                finals_n = jax.tree.map(
-                    lambda a: jnp.broadcast_to(a, (N,) + a.shape), final_n
+                cmax_alloc = st.cmax_alloc.at[idx_c].set(
+                    jnp.where(is_full_w[:, None], cmax_full[None], cmax_last[None])
                 )
-                creq = K._reqs_where(touched, finals_n, st.creq)
-                count = jnp.where(touched, fills, st.count)
-                active = st.active | touched
-                tmpl = jnp.where(touched, t, st.tmpl)
+                finals_w = jax.tree.map(
+                    lambda a: jnp.broadcast_to(a, (W,) + a.shape), final_n
+                )
+                creq = _set_rows(st.creq, idx_c, finals_w, pred_c)
+                count = st.count.at[idx_c].set(fills_w)
+                active = st.active.at[idx_c].set(True)
+                tmpl = st.tmpl.at[idx_c].set(t)
                 # claim q's last fill event: cumulative pods through it
-                cumfills = jnp.cumsum(fills) - 1
-                seq2 = jnp.where(touched, nseq + cumfills, seq)
+                cum_w = jnp.cumsum(fills_w) - 1  # [W]
+                seq2 = seq.at[idx_c].set(nseq + cum_w)
                 nseq2 = nseq + f
                 finals = jax.tree.map(
                     lambda a: jnp.broadcast_to(a, (W,) + a.shape), final_n
@@ -707,6 +725,10 @@ def solve_runs(
 
     def cond(carry):
         (_, _, _, _, ptr, _, _, over), _ = carry
+        # overflow stops the walk at the CURRENT pod: everything before
+        # ptr is decided and N-invariant (slot count only gates creation),
+        # so the host can pad the state to more slots and continue from
+        # ptr instead of re-solving from scratch
         return (ptr < n_valid) & ~over
 
     def body(carry):
@@ -737,4 +759,4 @@ def solve_runs(
             (jnp.int32(0), jnp.int32(0)),
         ),
     )
-    return st, seq, next_seq, kinds[:P], slots[:P], over, iters
+    return st, seq, next_seq, kinds[:P], slots[:P], over, iters, ptr

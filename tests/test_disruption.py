@@ -1151,3 +1151,177 @@ def test_consolidation_simulation_partitions_on_tpu_path():
     names = {q.name for c in sim.results.new_node_claims for q in c.pods}
     names |= {q.name for n in sim.results.existing_nodes for q in n.pods}
     assert p.name in names
+
+
+# ---------------------------------------------------------------------------
+# Pod eviction cost (reference suite_test.go:843-897, utils/disruption
+# disruption.go:37-78) — round 5
+
+
+def test_pod_eviction_cost_standard():
+    from karpenter_tpu.controllers.disruption.types import eviction_cost
+
+    assert eviction_cost(fixtures.pod(name="p")) == 1.0
+
+
+def test_pod_eviction_cost_deletion_cost_annotation():
+    from karpenter_tpu.controllers.disruption.types import (
+        POD_DELETION_COST_ANNOTATION,
+        eviction_cost,
+    )
+
+    def with_cost(v):
+        p = fixtures.pod(name=f"p{v}")
+        p.metadata.annotations[POD_DELETION_COST_ANNOTATION] = str(v)
+        return p
+
+    assert eviction_cost(with_cost(100)) > 1.0
+    assert eviction_cost(with_cost(-100)) < 1.0
+    # monotone in the annotation value (suite_test.go:865)
+    assert (
+        eviction_cost(with_cost(101))
+        > eviction_cost(with_cost(100))
+        > eviction_cost(with_cost(99))
+    )
+    # clamp to [-10, 10]
+    assert eviction_cost(with_cost(2**40)) == 10.0
+    assert eviction_cost(with_cost(-(2**40))) == -10.0
+    # malformed annotation ignored
+    p = fixtures.pod(name="bad")
+    p.metadata.annotations[POD_DELETION_COST_ANNOTATION] = "not-a-number"
+    assert eviction_cost(p) == 1.0
+
+
+def test_pod_eviction_cost_priority():
+    from karpenter_tpu.controllers.disruption.types import eviction_cost
+
+    hi = fixtures.pod(name="hi")
+    hi.priority = 1
+    lo_ = fixtures.pod(name="lo")
+    lo_.priority = -1
+    assert eviction_cost(hi) > 1.0
+    assert eviction_cost(lo_) < 1.0
+
+
+def test_lifetime_remaining_scales_disruption_cost():
+    """types.go:132 — cost scales by the fraction of expireAfter left."""
+    from karpenter_tpu.api.objects import NodeClaim, ObjectMeta
+    from karpenter_tpu.controllers.disruption.types import disruption_cost
+
+    clock = FakeClock()
+    claim = NodeClaim(metadata=ObjectMeta(name="c"))
+    claim.metadata.creation_timestamp = clock.now()
+    claim.expire_after_seconds = 100.0
+    pods = [fixtures.pod(name="p")]
+    full = disruption_cost(pods, clock, claim)
+    clock.advance(50.0)
+    half = disruption_cost(pods, clock, claim)
+    clock.advance(100.0)
+    expired = disruption_cost(pods, clock, claim)
+    assert full == 1.0 and abs(half - 0.5) < 1e-9 and expired == 0.0
+    # no expiry -> no scaling
+    claim.expire_after_seconds = None
+    assert disruption_cost(pods, clock, claim) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Candidate filtering x TerminationGracePeriod x disruption class
+# (suite_test.go:1022-1176; types.go:47-48, 118) — round 5
+
+
+def test_candidate_filtering_tgp_matrix():
+    """do-not-disrupt pods: block GRACEFUL disruption always; block
+    EVENTUAL disruption only when the claim has no TerminationGracePeriod."""
+    op = settled_operator(
+        n_pods=2, pod_kw=dict(labels={"app": "hold"})
+    )
+    mark_consolidatable(op)
+    # pin a do-not-disrupt pod
+    pod = op.kube.list("Pod")[0]
+    pod.metadata.annotations[well_known.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+    op.kube.update("Pod", pod)
+    node_name = pod.node_name
+
+    def names(disruption_class):
+        return [
+            c.name
+            for c in build_candidates(
+                op.kube, op.cluster, op.cloud, op.clock, lambda c: True,
+                disruption_class=disruption_class,
+            )
+        ]
+
+    # no TGP: blocked for both classes (suite_test.go:1148)
+    assert node_name not in names("graceful")
+    assert node_name not in names("eventual")
+
+    # TGP set on the claim: eventual may proceed, graceful still blocked
+    (claim,) = [
+        c for c in op.kube.list("NodeClaim") if c.status.node_name == node_name
+    ]
+    claim.termination_grace_period_seconds = 300.0
+    op.kube.update("NodeClaim", claim)
+    assert node_name not in names("graceful")  # suite_test.go:1083
+    assert node_name in names("eventual")  # suite_test.go:1022
+
+
+def test_candidate_filtering_tgp_matrix_pdb():
+    """Fully-blocking PDBs follow the same class x TGP rule
+    (suite_test.go:1051/1112/1176)."""
+    from karpenter_tpu.api.objects import LabelSelector, ObjectMeta, PodDisruptionBudget
+
+    op = settled_operator(n_pods=2, pod_kw=dict(labels={"app": "frozen"}))
+    mark_consolidatable(op)
+    op.kube.create(
+        "PodDisruptionBudget",
+        PodDisruptionBudget(
+            metadata=ObjectMeta(name="freeze"),
+            selector=LabelSelector(match_labels={"app": "frozen"}),
+            max_unavailable="0",
+        ),
+    )
+    pod_nodes = {p.node_name for p in op.kube.list("Pod") if p.node_name}
+
+    def names(disruption_class):
+        return [
+            c.name
+            for c in build_candidates(
+                op.kube, op.cluster, op.cloud, op.clock, lambda c: True,
+                disruption_class=disruption_class,
+            )
+        ]
+
+    assert not any(n in pod_nodes for n in names("graceful"))
+    assert not any(n in pod_nodes for n in names("eventual"))
+    for claim in op.kube.list("NodeClaim"):
+        claim.termination_grace_period_seconds = 300.0
+        op.kube.update("NodeClaim", claim)
+    assert not any(n in pod_nodes for n in names("graceful"))
+    assert any(n in pod_nodes for n in names("eventual"))
+
+
+# ---------------------------------------------------------------------------
+# Emptiness considers pending pods (emptiness_test.go:497) — round 5
+
+
+def test_emptiness_considers_pending_pods():
+    """An empty node a pending pod is about to land on must not be deleted
+    out from under it: the nomination window + validation veto keep the
+    node alive until the pod binds."""
+    op = settled_operator(n_pods=1)
+    # free the node: delete the pod, stamp conditions, make consolidatable
+    op.kube.delete("Pod", "w-0")
+    op.clock.advance(25.0)
+    op.claim_conditions.reconcile_all()
+    n_nodes = len(op.kube.list("Node"))
+    assert n_nodes == 1
+
+    # a pending pod arrives that fits the empty node
+    op.kube.create("Pod", fixtures.pod(name="late", requests={"cpu": "500m"}))
+    # drive full operator ticks: provisioning must win the race with
+    # emptiness — the pod binds to the EXISTING node, no deletion, no new
+    # node
+    assert op.run_until_settled(max_ticks=40) < 40
+    assert len(op.kube.list("Node")) == 1
+    late = op.kube.get("Pod", "late")
+    assert late.node_name

@@ -407,3 +407,78 @@ def test_dedup_decode_path_parity(monkeypatch):
     monkeypatch.setattr(tpu_mod, "_DEDUP_DECODE_MIN", 64)
     dedup = solve_once()
     assert raw == dedup
+
+
+def test_overflow_growth_continuation_parity():
+    """Overflow continuation (round 5): the runs kernel stops at the pod
+    that found no free claim slot; the host pads the carried state and
+    resumes from exactly that pod. Decisions are N-invariant (slot count
+    only gates creation), so a deliberately undersized slot pool that
+    forces several growth events must reproduce the oracle bit-for-bit."""
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver.oracle import Scheduler, SchedulerOptions
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import TpuScheduler
+    from karpenter_tpu.testing import fixtures
+
+    its = construct_instance_types(sizes=[2, 8])
+    pool = fixtures.node_pool(name="default")
+
+    def solve_with(cls, **kw):
+        fixtures.reset_rng(11)
+        pods = fixtures.make_diverse_pods(400)
+        topo = Topology([pool], {"default": its}, pods)
+        return cls([pool], {"default": its}, topo, **kw).solve(pods)
+
+    opts = SchedulerOptions()
+    opts.claim_slot_div = 64  # tiny start: forces growth mid-solve
+    rt = solve_with(TpuScheduler, options=opts)
+    ro = solve_with(Scheduler)
+
+    def snap(r):
+        out = {}
+        for c in r.new_node_claims:
+            group = tuple(sorted(p.name for p in c.pods))
+            for p in c.pods:
+                out[p.name] = group
+        return out
+
+    assert snap(rt) == snap(ro)
+    assert len(rt.new_node_claims) == len(ro.new_node_claims)
+    assert rt.pod_errors == ro.pod_errors
+
+
+def test_single_step_overflow_pod_is_retried_not_failed():
+    """A pod that overflows the slot pool in the EXACT per-pod path is not
+    a decided failure: the kernel leaves ptr on it and the host retries it
+    on the grown state (round-5 fix — advancing past it could let the
+    stall check end the solve with the pod wrongly unschedulable).
+    70 hostname-anti-affinity pods (one claim each, exact path) against a
+    64-slot start must all schedule, matching the oracle."""
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver.oracle import Scheduler, SchedulerOptions
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import TpuScheduler
+    from karpenter_tpu.testing import fixtures
+
+    its = construct_instance_types(sizes=[2])
+    pool = fixtures.node_pool(name="default")
+
+    def make_pods():
+        fixtures.reset_rng(5)
+        from karpenter_tpu.api import labels as well_known
+
+        return fixtures.make_pod_anti_affinity_pods(
+            70, well_known.HOSTNAME_LABEL_KEY
+        )
+
+    opts = SchedulerOptions()
+    opts.claim_slot_div = 10_000  # floor of 64 slots -> overflow at pod 65
+    pods = make_pods()
+    topo = Topology([pool], {"default": its}, pods)
+    rt = TpuScheduler([pool], {"default": its}, topo, options=opts).solve(pods)
+    pods2 = make_pods()
+    topo2 = Topology([pool], {"default": its}, pods2)
+    ro = Scheduler([pool], {"default": its}, topo2).solve(pods2)
+    assert len(rt.pod_errors) == len(ro.pod_errors) == 0
+    assert len(rt.new_node_claims) == len(ro.new_node_claims) == 70
