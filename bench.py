@@ -294,6 +294,16 @@ def main() -> None:
         except Exception as e:  # pragma: no cover - report, don't die
             detail["c4_consolidation_sweep_2k"] = {"error": str(e)}
 
+        log("== config 7 (extra): single-node consolidation, 1k nodes ==")
+        try:
+            from karpenter_tpu.controllers.disruption.sweep import (
+                bench_single_sweep,
+            )
+
+            detail["c7_single_node_sweep_1k"] = bench_single_sweep(1000, 100)
+        except Exception as e:  # pragma: no cover - report, don't die
+            detail["c7_single_node_sweep_1k"] = {"error": str(e)}
+
         log("== config 6 (extra): realistic mix — 2% relaxable pods ==")
         its = build_universe(500)
         tpu_ps, comp, used_tpu = time_hybrid(10_000, its, pods_realistic)

@@ -322,7 +322,20 @@ class MultiNodeConsolidation(ConsolidationBase):
 
 class SingleNodeConsolidation(ConsolidationBase):
     """singlenodeconsolidation.go:56: per-candidate simulation, nodepool
-    round-robin ordering so one big pool can't starve the others."""
+    round-robin ordering so one big pool can't starve the others.
+
+    Round 5: the per-candidate simulations are INDEPENDENT — with
+    sweep="batched" (default) one device call computes every candidate's
+    removal feasibility as a lane of the delta-state sweep
+    (disruption/sweep.py singleton mode); the sequential walk then only
+    runs the full exact simulation on candidates whose lane came back
+    feasible (an infeasible lane can only ever produce a no-op command,
+    so skipping it is exact). Shapes the sweep can't express fall back to
+    the reference's sequential scan."""
+
+    def __init__(self, *args, sweep: str = "batched", **kwargs):
+        super().__init__(*args, **kwargs)
+        self.sweep = sweep
 
     def compute_commands(self) -> list[Command]:
         candidates = self.candidates()
@@ -339,12 +352,27 @@ class SingleNodeConsolidation(ConsolidationBase):
             if by_pool[pool]:
                 ordered.append(by_pool[pool].pop(0))
             i += 1
+        feasible = None
+        if self.sweep == "batched" and len(ordered) > 1:
+            from karpenter_tpu.controllers.disruption.sweep import (
+                SweepUnsupported,
+                singleton_feasibility,
+            )
+
+            try:
+                feasible = singleton_feasibility(
+                    self.kube, self.cluster, self.cloud, ordered, self.opts
+                )
+            except SweepUnsupported:
+                feasible = None
         deadline = self.clock.now() + self.opts.multinode_consolidation_timeout_seconds
-        for c in ordered:
+        for j, c in enumerate(ordered):
             if self.clock.now() > deadline:
                 break
             if not budgets.can_disrupt(c.nodepool_name):
                 continue
+            if feasible is not None and not feasible[j]:
+                continue  # lane says removal can't reschedule: no-op anyway
             cmd = self.compute_consolidation([c])
             if cmd.candidates:
                 return [cmd]

@@ -192,8 +192,10 @@ def build_candidates(
         if blocked:
             continue
         c.reschedulable_pods = [p for p in pods if is_reschedulable(p)]
+        # cost over ALL pods on the candidate, not just reschedulable ones
+        # (types.go:131-132 — "we get the disruption cost from all pods")
         c.disruption_cost = disruption_cost(
-            c.reschedulable_pods, clock, c.state_node.node_claim
+            pods, clock, c.state_node.node_claim
         )
         c.price = _candidate_price(c, cloud_provider, its_cache)
         if should_disrupt(c):

@@ -77,7 +77,7 @@ class SweepUnsupported(Exception):
 _fast_sweep_cached = None
 
 
-def _fast_sweep_kernel(tb, st, x, avail0, cand_idx, counts, sizes):
+def _fast_sweep_kernel(tb, st, x, avail0, cand_idx, counts, sizes, singleton=False):
     """The delta-state consolidation sweep (module docstring §fast path).
 
     Key identity: FFD of a CLASS-GROUPED pod sequence with capacity-only
@@ -107,9 +107,17 @@ def _fast_sweep_kernel(tb, st, x, avail0, cand_idx, counts, sizes):
     B, C = counts.shape
     INF = jnp.int32(1 << 30)
     karr = jnp.arange(B, dtype=jnp.int32)
-    # per-lane availability: removed candidate slots fit nothing (-1)
+    # per-lane availability: removed candidate slots fit nothing (-1).
+    # prefix mode: lane k removes candidates[:k+1]; singleton mode
+    # (single-node consolidation, round 5): lane k removes ONLY
+    # candidates[k] — the lanes are fully independent simulations
+    removed = (
+        cand_idx[None, :] == karr[:, None]
+        if singleton
+        else cand_idx[None, :] <= karr[:, None]
+    )
     avail = jnp.where(
-        (cand_idx[None, :] <= karr[:, None])[..., None],
+        removed[..., None],
         jnp.int32(-1),
         avail0[None],
     )  # [B, E, R]
@@ -163,7 +171,8 @@ def _fast_sweep_kernel(tb, st, x, avail0, cand_idx, counts, sizes):
 
 
 def _fast_prefix_feasibility(
-    sched, problem, candidates, view_slot, order, pod_prefix, tb, base_st
+    sched, problem, candidates, view_slot, order, pod_prefix, tb, base_st,
+    singleton=False,
 ):
     """Gate-check + run the delta-state sweep kernel; None = gates failed,
     caller falls back to the vmapped full-state sweep. tb/base_st come
@@ -208,7 +217,11 @@ def _fast_prefix_feasibility(
             base[cpos] += 1  # pending pods: valid in every prefix
         else:
             M[ppi, cpos] += 1
-    counts = (np.cumsum(M, axis=0) + base[None]).astype(np.int32)
+    # prefix lanes accumulate candidates[:k+1]'s pods; singleton lanes
+    # carry only candidate k's
+    counts = (
+        (M + base[None]) if singleton else (np.cumsum(M, axis=0) + base[None])
+    ).astype(np.int32)
     sizes = p.prequests_c[class_seq].astype(np.int32)
     cand_idx = np.full(p.num_existing, (1 << 30), np.int32)
     for j, c in enumerate(candidates):
@@ -219,7 +232,7 @@ def _fast_prefix_feasibility(
     # feasibility verdicts must never ride a wrapped total. Worst-case
     # leftover total is every union pod left over; worst-case capacity
     # cumsum is the base availability divided by the class size.
-    worst_tot = counts[-1].astype(np.int64) @ sizes.astype(np.int64)
+    worst_tot = counts.max(axis=0).astype(np.int64) @ sizes.astype(np.int64)
     if (worst_tot >= (1 << 30)).any():
         return None
     avail64 = p.eavail.astype(np.int64)
@@ -237,7 +250,9 @@ def _fast_prefix_feasibility(
 
     global _fast_sweep_cached
     if _fast_sweep_cached is None:
-        _fast_sweep_cached = jax.jit(_fast_sweep_kernel)
+        _fast_sweep_cached = jax.jit(
+            _fast_sweep_kernel, static_argnames=("singleton",)
+        )
     feasible = _fast_sweep_cached(
         tb,
         base_st,
@@ -246,6 +261,7 @@ def _fast_prefix_feasibility(
         jnp.asarray(cand_idx),
         jnp.asarray(counts),
         jnp.asarray(sizes),
+        singleton=singleton,
     )
     return [bool(v) for v in np.asarray(jax.device_get(feasible))]
 
@@ -256,9 +272,15 @@ def prefix_feasibility(
     cloud_provider,
     candidates: list[Candidate],
     options=None,
+    singleton: bool = False,
 ) -> list[bool]:
-    """[len(candidates)] — feasible(k) for removing candidates[:k+1], all
-    prefixes evaluated in one vmapped device call."""
+    """[len(candidates)] — feasible(k), all lanes evaluated in one device
+    call. Prefix mode (multi-node consolidation): lane k removes
+    candidates[:k+1]. Singleton mode (single-node consolidation, round
+    5): lane k removes ONLY candidates[k] — the same machinery with
+    per-candidate instead of cumulative deltas (singlenodeconsolidation
+    .go:56 loops these simulations sequentially; here they are
+    independent device lanes)."""
     from karpenter_tpu.jaxsetup import ensure_compilation_cache
 
     ensure_compilation_cache()
@@ -357,7 +379,8 @@ def prefix_feasibility(
     # cumsum steps on device (see _fast_sweep_kernel); the vmapped
     # full-state scan below remains the exact fallback for everything else
     fast = _fast_prefix_feasibility(
-        sched, problem, candidates, view_slot, order, pod_prefix, tb, base
+        sched, problem, candidates, view_slot, order, pod_prefix, tb, base,
+        singleton=singleton,
     )
     if fast is not None:
         return fast
@@ -437,45 +460,60 @@ def prefix_feasibility(
                     continue
                 (add_h if resched else rm_h)[j, g, slot_of[j]] += 1
 
-    # prefix k (0-based) removes candidates[:k+1]
-    cum_add_v = np.cumsum(add_v, axis=0)
-    cum_rm_v = np.cumsum(rm_v, axis=0)
-    cum_add_h = np.cumsum(add_h, axis=0)
-    cum_rm_h = np.cumsum(rm_h, axis=0)
-    tot_add_v = cum_add_v[-1]
-    tot_add_h = cum_add_h[-1]
+    tot_add_v = add_v.sum(axis=0)
+    tot_add_h = add_h.sum(axis=0)
 
     # ---- batched state ---------------------------------------------------
     eavail_b = np.broadcast_to(
         np.asarray(base.eavail), (B,) + base.eavail.shape
     ).copy()
-    for k in range(B):
-        for j in range(k + 1):
-            eavail_b[k, slot_of[j], :] = -1  # removed: fits nothing
-    v_cnt_b = (
-        np.asarray(base.v_cnt)[None]
-        + (tot_add_v[None] - cum_add_v)
-        - cum_rm_v
-    )
-    h_cnt_b = (
-        np.asarray(base.h_cnt)[None]
-        + (tot_add_h[None] - cum_add_h)
-        - cum_rm_h
-    )
+    if singleton:
+        for k in range(B):
+            eavail_b[k, slot_of[k], :] = -1  # only candidate k removed
+        # kept candidates' reschedulable pods stay counted; only lane k's
+        # own pods move and its non-reschedulable riders vanish
+        v_cnt_b = (
+            np.asarray(base.v_cnt)[None] + (tot_add_v[None] - add_v) - rm_v
+        )
+        h_cnt_b = (
+            np.asarray(base.h_cnt)[None] + (tot_add_h[None] - add_h) - rm_h
+        )
+    else:
+        # prefix k (0-based) removes candidates[:k+1]
+        cum_add_v = np.cumsum(add_v, axis=0)
+        cum_rm_v = np.cumsum(rm_v, axis=0)
+        cum_add_h = np.cumsum(add_h, axis=0)
+        cum_rm_h = np.cumsum(rm_h, axis=0)
+        for k in range(B):
+            for j in range(k + 1):
+                eavail_b[k, slot_of[j], :] = -1  # removed: fits nothing
+        v_cnt_b = (
+            np.asarray(base.v_cnt)[None]
+            + (tot_add_v[None] - cum_add_v)
+            - cum_rm_v
+        )
+        h_cnt_b = (
+            np.asarray(base.h_cnt)[None]
+            + (tot_add_h[None] - cum_add_h)
+            - cum_rm_h
+        )
 
     xs = sched._pod_xs(problem, order)
     P_pad = int(xs.valid.shape[0])
     valid_b = np.zeros((B, P_pad), bool)
     pp = np.asarray([pod_prefix[i] for i in order])
     for k in range(B):
-        valid_b[k, : len(order)] = pp <= k
+        if singleton:
+            valid_b[k, : len(order)] = (pp == k) | (pp < 0)
+        else:
+            valid_b[k, : len(order)] = pp <= k
 
     st_axes = K.State(
         active=None, count=None, rank=None, tmpl=None,
         creq=type(base.creq)(*(None,) * len(base.creq)),
         crequests=None, alive=None, cmax_alloc=None, n_claims=None,
         ereq=type(base.ereq)(*(None,) * len(base.ereq)),
-        eavail=0, trem=None, v_cnt=0, h_cnt=0,
+        eavail=0, trem=None, v_cnt=0, h_cnt=0, rescap=None, held=None,
     )
     xs_axes = K.PodX(
         preq=type(xs.preq)(*(None,) * len(xs.preq)),
@@ -505,15 +543,27 @@ def prefix_feasibility(
 
     feasible = []
     for k in range(B):
+        lane_pods = ((pp == k) | (pp < 0)) if singleton else (pp <= k)
         ok = (
             not bool(over[k])
             and int(n_claims[k]) <= 1
             and not np.any(
-                (kinds[k, : len(order)] == K.KIND_FAIL) & (pp <= k)
+                (kinds[k, : len(order)] == K.KIND_FAIL) & lane_pods
             )
         )
         feasible.append(ok)
     return feasible
+
+
+def singleton_feasibility(
+    kube, cluster, cloud_provider, candidates: list[Candidate], options=None
+) -> list[bool]:
+    """[len(candidates)] — can candidate k ALONE be removed with all its
+    pods rescheduling onto the remaining cluster plus at most one new
+    node? Every candidate is an independent device lane."""
+    return prefix_feasibility(
+        kube, cluster, cloud_provider, candidates, options, singleton=True
+    )
 
 
 def sweep_first_n(consolidation, candidates: list[Candidate]):
@@ -612,4 +662,67 @@ def bench_sweep(n_nodes: int = 2000, n_candidates: int = 100) -> dict:
         "tpu_binary_prefix": len(cmd_tpu.candidates),
         "agree": largest == len(cmd_binary.candidates)
         and len(cmd_tpu.candidates) == len(cmd_binary.candidates),
+    }
+
+
+def bench_single_sweep(n_nodes: int = 1000, n_candidates: int = 100) -> dict:
+    """Single-node consolidation: batched singleton lanes vs the
+    reference's sequential per-candidate walk
+    (singlenodeconsolidation.go:56). The fleet is fully feasible, so the
+    sequential walk's first simulation already returns a command — the
+    honest comparison is the FEASIBILITY phase: one singleton sweep over
+    all candidates vs one sequential simulation per candidate."""
+    import time as _t
+
+    from karpenter_tpu.api.objects import Budget
+    from karpenter_tpu.controllers.disruption.helpers import simulate_scheduling
+    from karpenter_tpu.controllers.disruption.consolidation import (
+        SingleNodeConsolidation,
+    )
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.operator import Operator
+    from karpenter_tpu.testing import fixtures
+
+    op = Operator(clock=FakeClock(), force_oracle=False)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="default", budgets=[Budget(nodes="100%")]),
+    )
+    fixtures.reset_rng(7)
+    fixtures.make_underutilized_fleet(op, n_nodes, max_ticks=400)
+    op.clock.advance(30.0)
+    op.pod_events.reconcile_all()
+    op.claim_conditions.reconcile_all()
+
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    snc = SingleNodeConsolidation(*args, options=op.opts, force_oracle=True)
+    candidates = snc.candidates()[:n_candidates]
+
+    t0 = _t.monotonic()
+    feas = singleton_feasibility(op.kube, op.cluster, op.cloud, candidates, op.opts)
+    compile_s = _t.monotonic() - t0
+    t0 = _t.monotonic()
+    feas = singleton_feasibility(op.kube, op.cluster, op.cloud, candidates, op.opts)
+    sweep_s = _t.monotonic() - t0
+
+    t0 = _t.monotonic()
+    seq = []
+    for c in candidates:
+        sim = simulate_scheduling(
+            op.kube, op.cluster, op.cloud, [c], op.opts, force_oracle=True
+        )
+        seq.append(
+            sim.all_pods_scheduled() and len(sim.non_empty_new_claims()) <= 1
+        )
+    seq_s = _t.monotonic() - t0
+
+    return {
+        "nodes": n_nodes,
+        "candidates": len(candidates),
+        "sweep_seconds": round(sweep_s, 3),
+        "sweep_compile_seconds": round(max(0.0, compile_s - sweep_s), 1),
+        "sequential_seconds": round(seq_s, 3),
+        "speedup": round(seq_s / sweep_s, 2) if sweep_s else None,
+        "agree": feas == seq,
+        "feasible_count": sum(feas),
     }

@@ -171,6 +171,13 @@ class SimKube:
                 obj = copy.deepcopy(obj)
                 if getattr(obj, "metadata", None) is not None:
                     obj.metadata.resource_version = next(self._version)
+                    # the apiserver stamps creationTimestamp at admission;
+                    # age-based controllers (expiration, lifetime cost)
+                    # depend on it. A 0.0 timestamp is treated as UNSET
+                    # (the dataclass default) — a test modeling an old
+                    # object must backdate with any positive epoch.
+                    if not obj.metadata.creation_timestamp:
+                        obj.metadata.creation_timestamp = self.clock.now()
                 store[name] = obj
                 self._emit(ADDED, kind, copy.deepcopy(obj))
                 return copy.deepcopy(obj)

@@ -41,6 +41,27 @@ POD_STARTUP = metrics.REGISTRY.histogram(
 POD_UNDECIDED = metrics.REGISTRY.gauge(
     "karpenter_pods_scheduling_undecided", "Provisionable pods with no decision yet."
 )
+# pod lifecycle timing family (pod/controller.go:286-447, round 5):
+# per-pod "still waiting" gauges deleted on resolution + duration
+# histograms observed once at the transition
+POD_BOUND_DURATION = metrics.REGISTRY.histogram(
+    "karpenter_pods_bound_duration_seconds",
+    "Time from pod creation to binding (PodBoundDurationSeconds).",
+)
+POD_UNBOUND_TIME = metrics.REGISTRY.gauge(
+    "karpenter_pods_current_unbound_time_seconds",
+    "Per-pod time since creation while still unbound.",
+    ("name", "namespace"),
+)
+POD_UNSTARTED_TIME = metrics.REGISTRY.gauge(
+    "karpenter_pods_unstarted_time_seconds",
+    "Per-pod time since creation while not yet running.",
+    ("name", "namespace"),
+)
+POD_SCHEDULING_DECISION = metrics.REGISTRY.histogram(
+    "karpenter_pods_scheduling_decision_duration_seconds",
+    "Time from first seeing a provisionable pod to a scheduling decision.",
+)
 
 _node_store = metrics.Store(NODE_ALLOCATABLE)
 _usage_store = metrics.Store(NODE_USAGE)
@@ -107,28 +128,78 @@ class NodePoolMetricsController:
 
 
 class PodMetricsController:
+    """metrics/pod/controller.go:209-447, reduced to the sim's pod model:
+    binding = node_name set (no PodScheduled condition object), started =
+    phase Running. Waiting gauges are per-pod and deleted idempotently on
+    resolution exactly like the reference's; durations observe once."""
+
     def __init__(self, kube, cluster: Cluster, clock):
         self.kube = kube
         self.cluster = cluster
         self.clock = clock
         self._started: set[str] = set()
+        self._bound: set[str] = set()
+        self._acked: dict[str, float] = {}  # uid -> first provisionable time
+        self._decided: set[str] = set()
+        self._waiting_series: set[tuple[str, str, str]] = set()  # (kind, name, ns)
 
     def reconcile_all(self) -> None:
+        now = self.clock.now()
         counts: dict[str, int] = {}
         undecided = 0
+        live_waiting: set[tuple[str, str, str]] = set()
         for pod in self.kube.list("Pod"):
             counts[str(pod.phase.value)] = counts.get(str(pod.phase.value), 0) + 1
+            labels = {"name": pod.name, "namespace": pod.namespace}
+            created = pod.metadata.creation_timestamp
             if is_provisionable(pod):
+                self._acked.setdefault(pod.uid, now)
                 if pod.uid not in self.cluster.pod_scheduling_decisions:
                     undecided += 1
+            # scheduling-decision latency (pod/controller.go:263): ack ->
+            # first decision recorded in cluster state
             if (
-                pod.phase == PodPhase.RUNNING
-                and pod.uid not in self._started
-            ):
-                self._started.add(pod.uid)
-                POD_STARTUP.observe(
-                    max(0.0, self.clock.now() - pod.metadata.creation_timestamp)
+                pod.uid in self._acked
+                and pod.uid not in self._decided
+                and (
+                    pod.uid in self.cluster.pod_scheduling_decisions
+                    or pod.node_name
                 )
+            ):
+                self._decided.add(pod.uid)
+                POD_SCHEDULING_DECISION.observe(
+                    max(0.0, now - self._acked[pod.uid])
+                )
+            # bound family (recordPodBoundMetric)
+            if pod.node_name:
+                if pod.uid not in self._bound:
+                    self._bound.add(pod.uid)
+                    POD_BOUND_DURATION.observe(max(0.0, now - created))
+            elif pod.phase == PodPhase.PENDING:
+                POD_UNBOUND_TIME.set(max(0.0, now - created), labels)
+                live_waiting.add(("unbound", pod.name, pod.namespace))
+            # startup family (recordPodStartupMetric)
+            if pod.phase == PodPhase.RUNNING:
+                if pod.uid not in self._started:
+                    self._started.add(pod.uid)
+                    POD_STARTUP.observe(max(0.0, now - created))
+            elif pod.phase == PodPhase.PENDING:
+                POD_UNSTARTED_TIME.set(max(0.0, now - created), labels)
+                live_waiting.add(("unstarted", pod.name, pod.namespace))
+        # idempotent deletion of resolved/vanished waiting series
+        for kind, name, ns in self._waiting_series - live_waiting:
+            gauge = POD_UNBOUND_TIME if kind == "unbound" else POD_UNSTARTED_TIME
+            gauge.delete({"name": name, "namespace": ns})
+        self._waiting_series = live_waiting
+        # prune per-uid tracking for pods that no longer exist — a churning
+        # cluster must not grow these maps without bound
+        live_uids = {p.uid for p in self.kube.list("Pod")}
+        self._started &= live_uids
+        self._bound &= live_uids
+        self._decided &= live_uids
+        for uid in list(self._acked):
+            if uid not in live_uids:
+                del self._acked[uid]
         for phase, n in counts.items():
             POD_STATE.set(float(n), {"phase": phase})
         POD_UNDECIDED.set(float(undecided))

@@ -199,7 +199,10 @@ def _grow_state(st, seq, pad):
         def impl(st, seq, pad):
             import jax.numpy as jnp
 
-            (pcreq, pactive, pints, pcrequests, palive, pcmax, pseq, ph) = pad
+            (
+                pcreq, pactive, pints, pcrequests, palive, pcmax, pseq, ph,
+                pheld,
+            ) = pad
             cat = lambda a, b: jnp.concatenate([a, b], axis=0)
             return st._replace(
                 active=cat(st.active, pactive),
@@ -211,6 +214,7 @@ def _grow_state(st, seq, pad):
                 alive=cat(st.alive, palive),
                 cmax_alloc=cat(st.cmax_alloc, pcmax),
                 h_cnt=jnp.concatenate([st.h_cnt, ph], axis=1),
+                held=cat(st.held, pheld),
             ), cat(seq, pseq)
 
         _grow_state_cached = jax.jit(impl)
@@ -335,11 +339,23 @@ def _popcount_rows(seg: np.ndarray) -> np.ndarray:
     ).sum(axis=-1)
 
 
-def _bulk_gates(p: EncodedProblem) -> bool:
+def _bulk_gates(p: EncodedProblem, strict_types: bool = True) -> bool:
     """Problem-level gates for the run kernel's bulk phases (see
     solver/tpu_runs.py module docstring). When any fails, every pod runs
     the exact per-pod step inside the same kernel — correctness never
-    depends on these."""
+    depends on these.
+
+    strict_types: the per-key type-structure rule. The consolidation
+    sweep's delta kernel (disruption/sweep.py) has NO per-commit verify,
+    so it requires every concrete type key single-valued or spanning the
+    whole vocab segment (pairwise == three-way). The RUN kernel verifies
+    surviving types EXACTLY at every bulk commit (case_level okv /
+    case_solo tok / case_new t_final_i), so it only needs the screens to
+    be sound relative to the TYPE UNIVERSE: values a pod references that
+    no instance type carries (e.g. a preference for a zone that doesn't
+    exist) must not blunt the gate — compare row popcounts against the
+    union of type rows, not the whole segment (round 5; this is what kept
+    the realistic-mix bench on the per-pod path)."""
     if (p.treq.minv != -1).any() or (p.preq_c.minv != -1).any():
         return False
     if p.num_existing and (p.ereq.minv != -1).any():
@@ -347,15 +363,19 @@ def _bulk_gates(p: EncodedProblem) -> bool:
     if p.thas_limits.any():
         return False
     vocab = p.vocab
-    # instance-type requirement structure: pairwise screens are exact
-    # three-way only when every concrete type key is single-valued or spans
-    # the whole vocab segment
     for kid in range(vocab.num_keys):
         off, words = vocab.word_offset[kid], vocab.words_per_key[kid]
-        nvals = len(vocab.values[kid])
-        pop = _popcount_rows(p.ireq.mask[:, off : off + words])
+        seg = p.ireq.mask[:, off : off + words]
+        pop = _popcount_rows(seg)
         concrete = p.ireq.defined[:, kid] & ~p.ireq.other[:, kid]
-        if (concrete & (pop > 1) & (pop < nvals)).any():
+        if strict_types:
+            full = len(vocab.values[kid])
+        else:
+            union = np.bitwise_or.reduce(
+                np.where(concrete[:, None], seg, 0), axis=0
+            )
+            full = int(_popcount_rows(union[None])[0])
+        if (concrete & (pop > 1) & (pop < full)).any():
             return False
     # offerings decompose per key: every capacity-type a type offers must
     # cover the same zone set (so "an offering exists for the chosen zone"
@@ -472,7 +492,7 @@ class TpuScheduler:
         with prof.phase("upload"):
             tb = self._tables(problem)  # also sets self._typeok
             self._upload_pod_tables(problem)
-        gates_ok = _bulk_gates(problem)
+        gates_ok = _bulk_gates(problem, strict_types=False)
         self._bulk_flags_c = _bulk_class_flags(problem, gates_ok)
         # trace-time static: with no relaxable requirement classes the
         # compiled program carries no tier machinery at all (VERDICT r4 #1
@@ -731,6 +751,11 @@ class TpuScheduler:
             otype=jnp.asarray(p.otype),
             oword=jnp.asarray(p.oword),
             obit=jnp.asarray(p.obit),
+            orid=jnp.asarray(
+                p.orid
+                if p.orid is not None
+                else np.full(p.otype.shape[0], -1, np.int32)
+            ),
             v_kid=pad_group_v(p.v_kid),
             v_word=pad_group_v(p.v_word, fill=-1),
             v_bit=pad_group_v(p.v_bit),
@@ -794,6 +819,14 @@ class TpuScheduler:
             trem=jnp.asarray(p.tlimit_rem),
             v_cnt=jnp.asarray(v_cnt),
             h_cnt=jnp.asarray(h_cnt),
+            rescap=jnp.asarray(
+                p.rescap0
+                if p.rescap0 is not None
+                else np.zeros(0, np.int32)
+            ),
+            held=jnp.zeros(
+                (N, (p.num_reservations + 31) // 32), jnp.uint32
+            ),
         )
 
     def _grow(self, p: EncodedProblem, st, seq, N: int):
@@ -816,6 +849,7 @@ class TpuScheduler:
             jnp.zeros((N, R), jnp.int32),
             jnp.zeros(N, jnp.int32),
             jnp.zeros((Gh, N), jnp.int32),
+            jnp.zeros((N, st.held.shape[1]), jnp.uint32),
         )
         return _grow_state(st, seq, pad)
 
@@ -907,6 +941,7 @@ class TpuScheduler:
 
         vocab, table = p.vocab, p.table
         scheduler = self.oracle
+        st_dev = st  # the device State (st is rebound to the host view)
         # Two-phase fetch: the scalar claim count first, then ONLY the live
         # claim rows (pow2-bucketed so the slice jit caches) — most solves
         # fill a fraction of the N padded slots, and the tunnel charges per
@@ -1032,9 +1067,47 @@ class TpuScheduler:
             claim.reservation_manager = scheduler.reservation_manager
             claim.reserved_offerings = []
             claim.reserved_offering_strict = False
-            claim.reserved_capacity_enabled = False
+            claim.reserved_capacity_enabled = self.opts.reserved_capacity_enabled
             claim.annotations = dict(nct.annotations)
             claims.append(claim)
+
+        # reserved-capacity sync (round 5): the kernel's per-claim held
+        # bitmasks become the claims' reserved_offerings and the host
+        # ReservationManager's state, so finalize() (reservation-id
+        # requirements) and later solves see the device's consumption
+        if p.num_reservations and n_claims:
+            import jax as _jax
+
+            held_rows, _rescap = _jax.device_get(
+                (st_dev.held[:n_claims], st_dev.rescap)
+            )
+            held_bits = np.unpackbits(
+                np.ascontiguousarray(held_rows).astype("<u4").view(np.uint8),
+                axis=-1,
+                bitorder="little",
+            )[:, : p.num_reservations]
+            from karpenter_tpu.scheduling import ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+
+            for slot, claim in enumerate(claims):
+                rids = {p.rid_names[r] for r in np.flatnonzero(held_bits[slot])}
+                if not rids:
+                    continue
+                # the oracle's reserved_offerings list: every compatible
+                # reserved offering of a surviving type whose rid is held
+                # (nodes.py _offerings_to_reserve final pass)
+                offs = [
+                    o
+                    for it in claim.instance_type_options
+                    for o in it.offerings
+                    if o.available
+                    and o.capacity_type() == well_known.CAPACITY_TYPE_RESERVED
+                    and o.reservation_id() in rids
+                    and claim.requirements.is_compatible(
+                        o.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                    )
+                ]
+                claim.reserved_offerings = offs
+                scheduler.reservation_manager.reserve(claim.hostname, *offs)
 
         for e, node in enumerate(scheduler.existing_nodes):
             node.remaining_resources = table.decode(eavail[e])

@@ -80,6 +80,11 @@ class Tables(NamedTuple):
     otype: jax.Array  # [O]
     oword: jax.Array  # [O, 3]
     obit: jax.Array  # [O, 3]
+    # reservation index per offering (-1 = not a reserved offering);
+    # zero-length when the problem has no reservations — every reservation
+    # op below is Python-gated on NRES so reservation-free programs are
+    # byte-identical to before (round 5, reservationmanager.go:57-98)
+    orid: jax.Array  # [O] i32
     # zone-family groups [Gv, VMAX]
     v_kid: jax.Array
     v_word: jax.Array
@@ -130,6 +135,9 @@ class State(NamedTuple):
     # topology counts
     v_cnt: jax.Array  # [Gv, VMAX]
     h_cnt: jax.Array  # [Gh, S]  S = E + N
+    # reserved-capacity state (zero-width when NRES == 0):
+    rescap: jax.Array  # [NRES] i32 remaining per reservation id
+    held: jax.Array  # [N, NRESW] u32 bitmask of reservations each claim holds
 
 
 class PodX(NamedTuple):
@@ -691,6 +699,36 @@ def _step(tb: Tables, st: State, x: PodX):
         )
     )
 
+    # --- reservation bookkeeping (reservationmanager.go:57-98) ---
+    # Non-strict semantics: the committed claim's reserved-offering set is
+    # recomputed from its final requirements + surviving types; newly-held
+    # reservations consume capacity, dropped ones release it (idempotent
+    # per claim — the bitmask IS the per-hostname held set). Python-gated
+    # on NRES so reservation-free programs compile unchanged.
+    NRES = st.rescap.shape[0]
+    if NRES:
+        upd_r = pc | pn
+        slot_r = jnp.where(pc, slot_c, m)
+        final_r = _reqs_where(pc, final_cn, final_tn)
+        alive_r = jnp.where(pc, alive_cn, alive_tn)  # [I] bool
+        alive_o = alive_r[jnp.clip(tb.otype, 0, None)]
+        offb = _gather_bits(final_r.mask, tb.oword, tb.obit)  # [O, 3]
+        off_ok = jnp.all(offb | (tb.oword < 0), axis=-1)
+        cand_o = alive_o & off_ok & (tb.orid >= 0)
+        cand_r = (
+            jnp.zeros(NRES, bool).at[jnp.clip(tb.orid, 0, None)].max(cand_o)
+        )
+        NRESW = st.held.shape[1]
+        held_old = _unpack(st.held[slot_r], NRES)
+        new_held = cand_r & (held_old | (st.rescap > 0))
+        delta = new_held.astype(jnp.int32) - held_old.astype(jnp.int32)
+        rescap = jnp.where(upd_r, st.rescap - delta, st.rescap)
+        held = st.held.at[slot_r].set(
+            jnp.where(upd_r, _pack(new_held, NRESW), st.held[slot_r])
+        )
+    else:
+        rescap, held = st.rescap, st.held
+
     # --- topology record ---
     if E > 0:
         final_rec = _reqs_where(
@@ -724,6 +762,8 @@ def _step(tb: Tables, st: State, x: PodX):
         trem=trem,
         v_cnt=v_cnt,
         h_cnt=h_cnt,
+        rescap=rescap,
+        held=held,
     )
     out_slot = jnp.where(
         kind == KIND_EXISTING,
@@ -734,17 +774,28 @@ def _step(tb: Tables, st: State, x: PodX):
 
 
 def _x_at_tier(tb: Tables, x: PodX, t) -> PodX:
-    """The pod's PodX with tier-t requirement-class rows substituted
-    (requests, selection, inverse rows are tier-independent)."""
+    """The pod's PodX with tier-t requirement-class rows substituted where
+    the pod HAS tiers (requests, selection, inverse rows are
+    tier-independent). Single-tier pods keep their own rows — their rrow
+    is a placeholder and must never be dereferenced as truth; the selects
+    below are cheap (per-row gathers) next to the step's [N, TW]
+    candidate screens."""
     ri = x.rrow
+    has = x.ntiers > 1
+
+    def sel(tier_val, own_val):
+        return jnp.where(has, tier_val, own_val)
+
     return x._replace(
-        preq=Reqs(*(a[ri, t] for a in tb.rt_preq)),
-        typeok=tb.rt_typeok[ri, t],
-        tol_t=tb.rt_tol_t[ri, t],
-        tol_e=tb.rt_tol_e[ri, t],
-        topo_kind=tb.rt_kind[ri, t],
-        topo_gid=tb.rt_gid[ri, t],
-        topo_sel=tb.rt_sel[ri, t],
+        preq=Reqs(
+            *(sel(a[ri, t], b) for a, b in zip(tb.rt_preq, x.preq))
+        ),
+        typeok=sel(tb.rt_typeok[ri, t], x.typeok),
+        tol_t=sel(tb.rt_tol_t[ri, t], x.tol_t),
+        tol_e=sel(tb.rt_tol_e[ri, t], x.tol_e),
+        topo_kind=sel(tb.rt_kind[ri, t], x.topo_kind),
+        topo_gid=sel(tb.rt_gid[ri, t], x.topo_gid),
+        topo_sel=sel(tb.rt_sel[ri, t], x.topo_sel),
     )
 
 
@@ -752,32 +803,28 @@ def _step_relax(tb: Tables, st: State, x: PodX):
     """scheduler.go:434 trySchedule: a pod attempts its relaxation tiers
     IN ORDER within its own step (the reference relaxes inline on a copy
     until the pod schedules or the ladder is exhausted — no other pod
-    interleaves between tiers). Single-tier pods take the plain _step
-    through lax.cond, so problems without relaxable classes pay nothing
-    beyond the branch."""
+    interleaves between tiers). ONE while_loop for every pod — a
+    single-tier pod runs the body exactly once on its own rows — so the
+    compiled program contains a single _step instance (the former
+    cond(plain, tiers) duplicated the whole step and taxed mixed batches
+    with a branch per pod; VERDICT r4 #1)."""
 
-    def plain(_):
-        return _step(tb, st, x)
+    def cond(c):
+        t, done, _, _ = c
+        return (~done) & (t < x.ntiers)
 
-    def tiers(_):
-        def cond(c):
-            t, done, _, _ = c
-            return (~done) & (t < x.ntiers)
+    def body(c):
+        t, _, _, _ = c
+        st2, out = _step(tb, st, _x_at_tier(tb, x, t))
+        kind, _, over = out
+        done = (kind != KIND_FAIL) | over | ~x.valid
+        return (t + 1, done, st2, out)
 
-        def body(c):
-            t, _, _, _ = c
-            st2, out = _step(tb, st, _x_at_tier(tb, x, t))
-            kind, _, over = out
-            done = (kind != KIND_FAIL) | over | ~x.valid
-            return (t + 1, done, st2, out)
-
-        dummy = (jnp.int32(KIND_FAIL), jnp.int32(-1), jnp.zeros((), bool))
-        _, _, st2, out = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), jnp.zeros((), bool), st, dummy)
-        )
-        return st2, out
-
-    return jax.lax.cond(x.ntiers > 1, tiers, plain, None)
+    dummy = (jnp.int32(KIND_FAIL), jnp.int32(-1), jnp.zeros((), bool))
+    _, _, st2, out = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.zeros((), bool), st, dummy)
+    )
+    return st2, out
 
 
 @functools.partial(jax.jit, static_argnames=("relax",))

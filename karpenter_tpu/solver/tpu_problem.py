@@ -111,6 +111,11 @@ class EncodedProblem:
     otype: Optional[np.ndarray] = None  # [O] i32 owning type
     oword: Optional[np.ndarray] = None  # [O, 3] i32 word of zone/ct/rid bit (-1 = n/a)
     obit: Optional[np.ndarray] = None  # [O, 3] i32
+    # reserved-capacity bookkeeping (reservationmanager.go:28; round 5)
+    orid: Optional[np.ndarray] = None  # [O] i32 reservation index (-1 none)
+    num_reservations: int = 0
+    rid_names: list[str] = field(default_factory=list)  # [NRES]
+    rescap0: Optional[np.ndarray] = None  # [NRES] i32 initial capacities
 
     # existing nodes [E]
     ereq: Optional[Reqs] = None
@@ -293,17 +298,17 @@ def _walk_ladder(scheduler, pod: Pod) -> list[Pod]:
 def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
     """Build the full tensor problem from an oracle Scheduler + pod batch."""
     if scheduler.opts.reserved_capacity_enabled:
-        # the feature gate alone doesn't change semantics — only actual
-        # reserved offerings do (reservationmanager.go:28: with no
-        # reservation-id offerings, Reserve/Release never fire and the
-        # price ordering is untouched). Clusters running with the flag on
-        # but no capacity reservations ride the kernel.
+        # Round 5: NON-STRICT reserved capacity rides the kernel — the
+        # stateful per-reservation counting (reservationmanager.go:57-98)
+        # is a device-side capacity vector consumed at claim commits
+        # (tpu_kernel._step reservation bookkeeping; decisions themselves
+        # are unchanged in non-strict mode, only the held sets and the
+        # finalize-time requirements). STRICT mode can fail a can_add on
+        # reservation exhaustion (nodeclaim.go:227) — that per-candidate
+        # error path stays on the oracle.
         def is_reserved(o):
             if o.requirements.has(well_known.RESERVATION_ID_LABEL_KEY):
                 return True
-            # capacity-type 'reserved' without a reservation-id hits the
-            # oracle's reserve path too (nodes.py _offerings_to_reserve
-            # keys on capacity type; strict mode can raise) — gate both
             if o.requirements.has(well_known.CAPACITY_TYPE_LABEL_KEY):
                 r = o.requirements.get(well_known.CAPACITY_TYPE_LABEL_KEY)
                 if well_known.CAPACITY_TYPE_RESERVED in r.values:
@@ -316,7 +321,22 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
             for it in nct.instance_type_options
             for o in it.offerings
         )
-        _gate(has_reserved, "reserved capacity offerings present")
+        _gate(
+            has_reserved and scheduler.opts.reserved_offering_strict,
+            "strict reserved-offering mode with reserved offerings present",
+        )
+        _gate(
+            any(
+                o.requirements.has(well_known.CAPACITY_TYPE_LABEL_KEY)
+                and well_known.CAPACITY_TYPE_RESERVED
+                in o.requirements.get(well_known.CAPACITY_TYPE_LABEL_KEY).values
+                and not o.requirements.has(well_known.RESERVATION_ID_LABEL_KEY)
+                for nct in scheduler.templates
+                for it in nct.instance_type_options
+                for o in it.offerings
+            ),
+            "reserved offering without a reservation id",
+        )
 
     # the oracle handles the all-types-filtered-out case with per-pod errors
     # (scheduler.go:489); zero templates would also give zero-width tensors
@@ -462,6 +482,9 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
 
     # ---- offerings -----------------------------------------------------
     off_rows: list[tuple[int, list[int], list[int]]] = []
+    off_rids: list[int] = []  # reservation index per offering (-1 none)
+    rid_index: dict[str, int] = {}  # reservation id -> index
+    p.rid_names = []
     off_keys = (
         well_known.TOPOLOGY_ZONE_LABEL_KEY,
         well_known.CAPACITY_TYPE_LABEL_KEY,
@@ -489,11 +512,35 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
                 bits.append(vid % WORD_BITS)
             for key in o.requirements.keys() - set(off_keys):
                 raise UnsupportedBySolver(f"offering requirement on {key!r}")
+            # reservation bookkeeping rides capacity-type == reserved
+            # (nodes.py _offerings_to_reserve keys on capacity type)
+            rid = -1
+            if (
+                scheduler.opts.reserved_capacity_enabled
+                and o.capacity_type() == well_known.CAPACITY_TYPE_RESERVED
+            ):
+                name = o.reservation_id()
+                got = rid_index.get(name)
+                if got is None:
+                    got = len(rid_index)
+                    rid_index[name] = got
+                    p.rid_names.append(name)
+                rid = got
             off_rows.append((i, words, bits))
+            off_rids.append(rid)
     O = len(off_rows)
     p.otype = np.array([r[0] for r in off_rows], dtype=np.int32).reshape(O)
     p.oword = np.array([r[1] for r in off_rows], dtype=np.int32).reshape(O, 3)
     p.obit = np.array([r[2] for r in off_rows], dtype=np.int32).reshape(O, 3)
+    p.orid = np.array(off_rids, dtype=np.int32).reshape(O)
+    p.num_reservations = len(rid_index)
+    p.rescap0 = np.array(
+        [
+            scheduler.reservation_manager.capacity.get(name, 0)
+            for name in p.rid_names
+        ],
+        dtype=np.int32,
+    )
 
     # ---- existing nodes ------------------------------------------------
     E = len(scheduler.existing_nodes)

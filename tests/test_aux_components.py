@@ -889,3 +889,45 @@ def test_podevents_stamps_on_eviction_terminating():
     op.kube.update("Pod", p)
     t1 = op.kube.get("NodeClaim", claim_name).status.last_pod_event_time
     assert t1 > t0
+
+
+def test_pod_lifecycle_timing_metrics():
+    """metrics/pod/controller.go:286-447 family: unbound/unstarted waiting
+    gauges live while the pod waits and are deleted on resolution;
+    bound/startup/decision durations observe once."""
+    from karpenter_tpu.api.objects import PodPhase
+    from karpenter_tpu.controllers.metrics_controllers import (
+        POD_BOUND_DURATION,
+        POD_SCHEDULING_DECISION,
+        POD_UNBOUND_TIME,
+        POD_UNSTARTED_TIME,
+    )
+
+    op = small_op()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Pod", fixtures.pod(name="w", requests={"cpu": "500m"}))
+    bound_before = POD_BOUND_DURATION.count()
+    decision_before = POD_SCHEDULING_DECISION.count()
+
+    # one tick later: pod is pending/unbound -> waiting gauges are live
+    op.clock.advance(5.0)
+    op.pod_metrics.reconcile_all()
+    labels = {"name": "w", "namespace": "default"}
+    assert POD_UNBOUND_TIME.value(labels) >= 5.0
+    assert POD_UNSTARTED_TIME.value(labels) >= 5.0
+
+    # settle: pod binds -> bound duration observed, unbound gauge deleted
+    assert op.run_until_settled(max_ticks=40) < 40
+    op.pod_metrics.reconcile_all()
+    assert POD_BOUND_DURATION.count() == bound_before + 1
+    assert POD_SCHEDULING_DECISION.count() >= decision_before + 1
+    assert POD_UNBOUND_TIME.value(labels) == 0.0  # deleted on binding
+    # still pending-not-running: unstarted gauge persists
+    assert POD_UNSTARTED_TIME.value(labels) > 0.0
+
+    # pod runs -> startup observed, unstarted gauge deleted
+    p = op.kube.get("Pod", "w")
+    p.phase = PodPhase.RUNNING
+    op.kube.update("Pod", p)
+    op.pod_metrics.reconcile_all()
+    assert POD_UNSTARTED_TIME.value(labels) == 0.0

@@ -385,9 +385,17 @@ def test_reserved_capacity_gate_only_fires_with_reservations():
     want = Scheduler(*_problem(pods2)).solve(pods2)
     assert sorted(r.node_pod_counts()) == sorted(want.node_pod_counts())
 
-    # now add a reservation-id offering -> the gate fires, oracle runs
-    fixtures.reset_rng(7)
-    pods3 = fixtures.make_diverse_pods(12)
+    # round 5: reservation-id offerings RIDE the kernel in non-strict mode
+    # (the whole-problem gate at tpu_problem.py:295 is gone); only strict
+    # mode still falls back — see test_reserved_offerings_ride_kernel
+
+
+def _reserved_universe(capacity=4):
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.api.objects import Operator as Op
+    from karpenter_tpu.cloudprovider.types import Offering
+    from karpenter_tpu.scheduling import Requirement, Requirements
+
     its = _universe()
     it0 = its[0]
     it0.offerings.append(
@@ -401,13 +409,116 @@ def test_reserved_capacity_gate_only_fires_with_reservations():
             ),
             price=0.01,
             available=True,
-            reservation_capacity=4,
+            reservation_capacity=capacity,
         )
     )
+    return its
+
+
+def test_reserved_offerings_ride_kernel():
+    """Round 5 (VERDICT #5): non-strict reserved capacity runs ON the
+    kernel — used_tpu=True — with the held-reservation sets, the manager's
+    consumed capacity, and finalize()'s reservation-id requirements all
+    bit-identical to the oracle (reservationmanager.go:57-98)."""
+    from karpenter_tpu.solver.oracle import SchedulerOptions
+
+    def solve(cls, force=None):
+        fixtures.reset_rng(7)
+        pods = fixtures.make_diverse_pods(12)
+        its = _reserved_universe()
+        np_ = fixtures.node_pool(name="default")
+        topo = Topology([np_], {"default": its}, pods)
+        opts = SchedulerOptions(reserved_capacity_enabled=True, tpu_min_pods=0)
+        kw = {} if force is None else {"force_oracle": force}
+        s = cls([np_], {"default": its}, topo, options=opts, **kw)
+        return s, s.solve(pods)
+
+    h, r = solve(HybridScheduler, force=False)
+    assert h.used_tpu is True, h.fallback_reason
+    o, want = solve(Scheduler)
+
+    def snap(res, sched):
+        out = []
+        for c in sorted(
+            res.new_node_claims, key=lambda c: sorted(p.name for p in c.pods)
+        ):
+            c.finalize()
+            from karpenter_tpu.api import labels as wk
+
+            rid_req = (
+                tuple(sorted(c.requirements.get(wk.RESERVATION_ID_LABEL_KEY).values))
+                if c.requirements.has(wk.RESERVATION_ID_LABEL_KEY)
+                else ()
+            )
+            out.append(
+                (
+                    tuple(sorted(p.name for p in c.pods)),
+                    tuple(sorted(o.reservation_id() for o in c.reserved_offerings)),
+                    rid_req,
+                )
+            )
+        return out, dict(sched.oracle.reservation_manager.capacity) if hasattr(
+            sched, "oracle"
+        ) else dict(sched.reservation_manager.capacity)
+
+    got = snap(r, h)
+    exp = snap(want, o)
+    assert got == exp
+
+
+def test_reserved_capacity_exhaustion_matches_oracle():
+    """More claims than reservation capacity: the device capacity vector
+    must run out at exactly the same commit the oracle's does."""
+    from karpenter_tpu.solver.oracle import SchedulerOptions
+
+    def solve(cls, force=None):
+        fixtures.reset_rng(3)
+        # every pod too big to share: one claim per pod, 6 claims vs cap 2
+        pods = [
+            fixtures.pod(name=f"big-{i}", requests={"cpu": "28"})
+            for i in range(6)
+        ]
+        its = _reserved_universe(capacity=2)
+        np_ = fixtures.node_pool(name="default")
+        topo = Topology([np_], {"default": its}, pods)
+        opts = SchedulerOptions(reserved_capacity_enabled=True, tpu_min_pods=0)
+        kw = {} if force is None else {"force_oracle": force}
+        s = cls([np_], {"default": its}, topo, options=opts, **kw)
+        return s, s.solve(pods)
+
+    h, r = solve(HybridScheduler, force=False)
+    assert h.used_tpu is True, h.fallback_reason
+    o, want = solve(Scheduler)
+    got_held = sorted(
+        tuple(sorted(x.reservation_id() for x in c.reserved_offerings))
+        for c in r.new_node_claims
+    )
+    exp_held = sorted(
+        tuple(sorted(x.reservation_id() for x in c.reserved_offerings))
+        for c in want.new_node_claims
+    )
+    assert got_held == exp_held
+    assert (
+        h.oracle.reservation_manager.capacity
+        == o.reservation_manager.capacity
+    )
+
+
+def test_strict_reserved_mode_still_falls_back():
+    """Strict mode's per-candidate reservation errors stay on the oracle."""
+    from karpenter_tpu.solver.oracle import SchedulerOptions
+
+    fixtures.reset_rng(7)
+    pods = fixtures.make_diverse_pods(6)
+    its = _reserved_universe()
     np_ = fixtures.node_pool(name="default")
-    topo = Topology([np_], {"default": its}, pods3)
-    h3 = HybridScheduler([np_], {"default": its}, topo, options=SchedulerOptions(
-        reserved_capacity_enabled=True, tpu_min_pods=0))
-    h3.solve(pods3)
-    assert h3.used_tpu is False
-    assert "reserved" in (h3.fallback_reason or "")
+    topo = Topology([np_], {"default": its}, pods)
+    opts = SchedulerOptions(
+        reserved_capacity_enabled=True,
+        reserved_offering_strict=True,
+        tpu_min_pods=0,
+    )
+    h = HybridScheduler([np_], {"default": its}, topo, options=opts)
+    h.solve(pods)
+    assert h.used_tpu is False
+    assert "strict" in (h.fallback_reason or "")
