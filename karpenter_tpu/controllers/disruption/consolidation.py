@@ -353,7 +353,10 @@ class SingleNodeConsolidation(ConsolidationBase):
                 ordered.append(by_pool[pool].pop(0))
             i += 1
         feasible = None
-        if self.sweep == "batched" and len(ordered) > 1:
+        # force_oracle is the kernel kill-switch: never let the TPU sweep
+        # drive skip decisions for an oracle-forced controller (matches
+        # MultiNodeConsolidation.first_n_batched's guard)
+        if self.sweep == "batched" and not self.force_oracle and len(ordered) > 1:
             from karpenter_tpu.controllers.disruption.sweep import (
                 SweepUnsupported,
                 singleton_feasibility,

@@ -356,6 +356,11 @@ def prefix_feasibility(
         problem = encode_problem(sched.oracle, pods)
     except UnsupportedBySolver as e:
         raise SweepUnsupported(str(e)) from e
+    if problem.num_host_ports:
+        # per-lane host-port usage deltas (ports freed by removed
+        # candidates) aren't modeled in the batched construction; the
+        # sequential scans handle them exactly
+        raise SweepUnsupported("host ports in sweep problem")
 
     # FFD order shared with the oracle
     from karpenter_tpu.solver.ordering import ffd_sort_key
@@ -514,13 +519,14 @@ def prefix_feasibility(
         crequests=None, alive=None, cmax_alloc=None, n_claims=None,
         ereq=type(base.ereq)(*(None,) * len(base.ereq)),
         eavail=0, trem=None, v_cnt=0, h_cnt=0, rescap=None, held=None,
+        hp_used=None,
     )
     xs_axes = K.PodX(
         preq=type(xs.preq)(*(None,) * len(xs.preq)),
         prequests=None, typeok=None, tol_t=None, tol_e=None,
         topo_kind=None, topo_gid=None, topo_sel=None,
         sel_v=None, sel_h=None, inv_h=None, own_h=None, valid=0,
-        rrow=None, ntiers=None,
+        rrow=None, ntiers=None, hp_own=None, hp_conf=None,
     )
     st_b = base._replace(
         eavail=jnp.asarray(eavail_b),

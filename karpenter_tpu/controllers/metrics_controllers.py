@@ -141,14 +141,19 @@ class PodMetricsController:
         self._bound: set[str] = set()
         self._acked: dict[str, float] = {}  # uid -> first provisionable time
         self._decided: set[str] = set()
-        self._waiting_series: set[tuple[str, str, str]] = set()  # (kind, name, ns)
+        # waiting gauges GC through keyed Stores (store.go:33), same
+        # pattern the node gauge families use above
+        self._unbound_store = metrics.Store(POD_UNBOUND_TIME)
+        self._unstarted_store = metrics.Store(POD_UNSTARTED_TIME)
 
     def reconcile_all(self) -> None:
         now = self.clock.now()
         counts: dict[str, int] = {}
         undecided = 0
-        live_waiting: set[tuple[str, str, str]] = set()
+        live_waiting: set[tuple[str, str]] = set()
+        live_uids: set[str] = set()
         for pod in self.kube.list("Pod"):
+            live_uids.add(pod.uid)
             counts[str(pod.phase.value)] = counts.get(str(pod.phase.value), 0) + 1
             labels = {"name": pod.name, "namespace": pod.namespace}
             created = pod.metadata.creation_timestamp
@@ -170,30 +175,35 @@ class PodMetricsController:
                 POD_SCHEDULING_DECISION.observe(
                     max(0.0, now - self._acked[pod.uid])
                 )
+            key = f"{pod.namespace}/{pod.name}"
             # bound family (recordPodBoundMetric)
             if pod.node_name:
                 if pod.uid not in self._bound:
                     self._bound.add(pod.uid)
                     POD_BOUND_DURATION.observe(max(0.0, now - created))
             elif pod.phase == PodPhase.PENDING:
-                POD_UNBOUND_TIME.set(max(0.0, now - created), labels)
-                live_waiting.add(("unbound", pod.name, pod.namespace))
+                self._unbound_store.update(key, [(labels, max(0.0, now - created))])
+                live_waiting.add(("unbound", key))
             # startup family (recordPodStartupMetric)
             if pod.phase == PodPhase.RUNNING:
                 if pod.uid not in self._started:
                     self._started.add(pod.uid)
                     POD_STARTUP.observe(max(0.0, now - created))
             elif pod.phase == PodPhase.PENDING:
-                POD_UNSTARTED_TIME.set(max(0.0, now - created), labels)
-                live_waiting.add(("unstarted", pod.name, pod.namespace))
-        # idempotent deletion of resolved/vanished waiting series
-        for kind, name, ns in self._waiting_series - live_waiting:
-            gauge = POD_UNBOUND_TIME if kind == "unbound" else POD_UNSTARTED_TIME
-            gauge.delete({"name": name, "namespace": ns})
-        self._waiting_series = live_waiting
+                self._unstarted_store.update(
+                    key, [(labels, max(0.0, now - created))]
+                )
+                live_waiting.add(("unstarted", key))
+        # resolved/vanished waiting series GC through the stores
+        for store, kind in (
+            (self._unbound_store, "unbound"),
+            (self._unstarted_store, "unstarted"),
+        ):
+            for key in list(store._owned):
+                if (kind, key) not in live_waiting:
+                    store.delete(key)
         # prune per-uid tracking for pods that no longer exist — a churning
         # cluster must not grow these maps without bound
-        live_uids = {p.uid for p in self.kube.list("Pod")}
         self._started &= live_uids
         self._bound &= live_uids
         self._decided &= live_uids

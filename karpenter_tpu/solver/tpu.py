@@ -116,7 +116,7 @@ def _gather_xs(tables, idx, n):
                 preq_r, typeok_r, tol_t_r, tol_e_r,
                 kind_r, gid_r, tsel_r, rcls_of,
                 prequests_c, cls, srow, sel_rows_v, sel_rows_h,
-                inv_c, own_c, ntiers_r, rrow_of,
+                inv_c, own_c, ntiers_r, rrow_of, hp_own_r, hp_conf_r,
             ) = tables
             idx = idx.astype(jnp.int32)
             ci = cls[idx].astype(jnp.int32)
@@ -139,6 +139,8 @@ def _gather_xs(tables, idx, n):
                 valid=valid,
                 rrow=rrow_of[ri],
                 ntiers=ntiers_r[ri],
+                hp_own=hp_own_r[ri],
+                hp_conf=hp_conf_r[ri],
             )
 
         _gather_xs_cached = jax.jit(impl)
@@ -201,7 +203,7 @@ def _grow_state(st, seq, pad):
 
             (
                 pcreq, pactive, pints, pcrequests, palive, pcmax, pseq, ph,
-                pheld,
+                pheld, php,
             ) = pad
             cat = lambda a, b: jnp.concatenate([a, b], axis=0)
             return st._replace(
@@ -215,6 +217,7 @@ def _grow_state(st, seq, pad):
                 cmax_alloc=cat(st.cmax_alloc, pcmax),
                 h_cnt=jnp.concatenate([st.h_cnt, ph], axis=1),
                 held=cat(st.held, pheld),
+                hp_used=cat(st.hp_used, php),
             ), cat(seq, pseq)
 
         _grow_state_cached = jax.jit(impl)
@@ -362,6 +365,13 @@ def _bulk_gates(p: EncodedProblem, strict_types: bool = True) -> bool:
         return False
     if p.thas_limits.any():
         return False
+    # template daemonset host ports: bulk case_new creates claims without
+    # seeding thp into hp_used, so a later host-port pod could co-locate
+    # onto a conflicting bulk-created claim — run everything per-pod
+    # instead (port-OWNING classes are already excluded per class; this
+    # covers port-free bulk classes creating claims from porty templates)
+    if p.thp is not None and p.thp.any():
+        return False
     vocab = p.vocab
     for kid in range(vocab.num_keys):
         off, words = vocab.word_offset[kid], vocab.words_per_key[kid]
@@ -412,9 +422,16 @@ def _bulk_class_flags(p: EncodedProblem, gates_ok: bool) -> np.ndarray:
         return np.zeros(NC, bool)
     dyn_v = np.isin(p.ptopo_kind_c, (TOPO_SPREAD_V, TOPO_ANTI_V)) & p.ptopo_sel_c
     # relaxable classes run the exact per-pod step (the tier loop lives
-    # there); bulk phases assume a run of single-tier identical deciders
+    # there); bulk phases assume a run of single-tier identical deciders.
+    # host-port classes are slot-stateful per commit (hostportusage.go:35)
+    # and take the exact step too
     ntiers_c = p.ntiers_r[p.rcls_of]
-    return ~dyn_v.any(axis=1) & (ntiers_c == 1)
+    has_ports = (
+        p.php_own_c.any(axis=1)
+        if p.php_own_c is not None and p.php_own_c.shape[1]
+        else np.zeros(NC, bool)
+    )
+    return ~dyn_v.any(axis=1) & (ntiers_c == 1) & ~has_ports
 
 
 
@@ -768,6 +785,11 @@ class TpuScheduler:
             h_filt=pad_group_v(p.h_filt, fill=-1),
             h_inverse=pad_group_v(h_inverse, fill=False),
             filter_reqs=pad_reqs_rows(p.filter_reqs),
+            thp=jnp.asarray(
+                p.thp
+                if p.thp is not None
+                else np.zeros((p.num_templates, 0), np.uint32)
+            ),
             rt_preq=jreq(p.rt_preq),
             rt_typeok=jnp.zeros(
                 (1, 1, max(1, (p.num_types + 31) // 32)), jnp.uint32
@@ -827,6 +849,18 @@ class TpuScheduler:
             held=jnp.zeros(
                 (N, (p.num_reservations + 31) // 32), jnp.uint32
             ),
+            hp_used=jnp.concatenate(
+                [
+                    jnp.asarray(
+                        p.ehp
+                        if p.ehp is not None
+                        else np.zeros((E, 0), np.uint32)
+                    ),
+                    jnp.zeros(
+                        (N, (p.num_host_ports + 31) // 32), jnp.uint32
+                    ),
+                ]
+            ),
         )
 
     def _grow(self, p: EncodedProblem, st, seq, N: int):
@@ -850,6 +884,7 @@ class TpuScheduler:
             jnp.zeros(N, jnp.int32),
             jnp.zeros((Gh, N), jnp.int32),
             jnp.zeros((N, st.held.shape[1]), jnp.uint32),
+            jnp.zeros((N, st.hp_used.shape[1]), jnp.uint32),
         )
         return _grow_state(st, seq, pad)
 
@@ -896,6 +931,8 @@ class TpuScheduler:
             jnp.asarray(pad_g(p.pown_h_c, Gh)),
             jnp.asarray(p.ntiers_r),
             jnp.asarray(p.rrow_of_rcls),
+            jnp.asarray(p.php_own_c[cr]),
+            jnp.asarray(p.php_conf_c[cr]),
         )
         from karpenter_tpu.solver.tpu_problem import (
             TOPO_AFFINITY_H,
@@ -1078,9 +1115,7 @@ class TpuScheduler:
         if p.num_reservations and n_claims:
             import jax as _jax
 
-            held_rows, _rescap = _jax.device_get(
-                (st_dev.held[:n_claims], st_dev.rescap)
-            )
+            held_rows = _jax.device_get(st_dev.held[:n_claims])
             held_bits = np.unpackbits(
                 np.ascontiguousarray(held_rows).astype("<u4").view(np.uint8),
                 axis=-1,
@@ -1133,13 +1168,23 @@ class TpuScheduler:
                     rem[name] = int(trem[t, ri]) * table.scale[ri]
             scheduler.remaining_resources[nct.nodepool_name] = rem
 
+        from karpenter_tpu.scheduling.hostports import get_host_ports
+
         pod_errors: dict[str, str] = {}
         for i, pod in enumerate(p.pods):
             kind, slot = int(kinds[i]), int(slots[i])
             if kind == K.KIND_EXISTING:
                 scheduler.existing_nodes[slot].pods.append(pod)
+                if p.num_host_ports:
+                    hp = get_host_ports(pod)
+                    if hp:
+                        scheduler.existing_nodes[slot].host_port_usage.add(pod, hp)
             elif kind in (K.KIND_CLAIM, K.KIND_NEW):
                 claims[slot].pods.append(pod)
+                if p.num_host_ports:
+                    hp = get_host_ports(pod)
+                    if hp:
+                        claims[slot].host_port_usage.add(pod, hp)
             elif not timed_out:
                 pod_errors[pod.uid] = self._error_for(pod)
 

@@ -100,6 +100,9 @@ class Tables(NamedTuple):
     h_inverse: jax.Array  # [Gh] bool
     # node filters [F]
     filter_reqs: Reqs
+    # template daemonset host-port seeds [T, HPW] (zero-width when the
+    # problem has no host ports; every port op is Python-gated on HPW)
+    thp: jax.Array
     # relaxation-tier tables per requirement class [NR, L, ...]
     # (preferences.go:38 ladder, precomputed host-side: tier 0 = the pod
     # as submitted, tier t = after t relax rungs; a pod's step attempts
@@ -138,6 +141,8 @@ class State(NamedTuple):
     # reserved-capacity state (zero-width when NRES == 0):
     rescap: jax.Array  # [NRES] i32 remaining per reservation id
     held: jax.Array  # [N, NRESW] u32 bitmask of reservations each claim holds
+    # host-port usage per slot [S, HPW] u32 (hostportusage.go:35; S = E+N)
+    hp_used: jax.Array
 
 
 class PodX(NamedTuple):
@@ -160,6 +165,9 @@ class PodX(NamedTuple):
     # ntiers > 1) and how many ladder tiers it has (1 = nothing to relax)
     rrow: jax.Array  # scalar i32
     ntiers: jax.Array  # scalar i32
+    # host ports (tier-independent): own triple bits + conflict mask [HPW]
+    hp_own: jax.Array
+    hp_conf: jax.Array
 
 
 def _row(r: Reqs, i) -> Reqs:
@@ -496,6 +504,7 @@ def _step(tb: Tables, st: State, x: PodX):
     T = tb.tdaemon.shape[0]
     I = tb.ialloc.shape[0]
     IW = st.alive.shape[1]
+    HPW = st.hp_used.shape[1]
 
     nonempty_h = jnp.any(st.h_cnt > 0, axis=-1)  # [Gh]
 
@@ -515,6 +524,10 @@ def _step(tb: Tables, st: State, x: PodX):
             & te_e.viable
             & _topo_nonempty_ok(final_e, te_e.touched, tb.va)
         )
+        if HPW:  # host-port conflict screen (hostportusage.go:35)
+            cand_e &= ~jnp.any(
+                (x.hp_conf[None, :] & st.hp_used[:E]) != 0, axis=-1
+            )
         found_e = jnp.any(cand_e) & x.valid
         slot_e = jnp.argmin(jnp.where(cand_e, jnp.arange(E), INF_I))
     else:
@@ -545,6 +558,10 @@ def _step(tb: Tables, st: State, x: PodX):
         & screen_fits
         & screen_types
     )
+    if HPW:
+        cand_c &= ~jnp.any(
+            (x.hp_conf[None, :] & st.hp_used[E:]) != 0, axis=-1
+        )
 
     def loop_cond(carry):
         done, excluded, _ = carry
@@ -606,6 +623,8 @@ def _step(tb: Tables, st: State, x: PodX):
             & jnp.any(t_final_i, axis=-1)
             & t_minok
         )
+        if HPW:  # pod ports vs the template's daemonset ports
+            viable_nogate &= ~jnp.any((x.hp_conf[None, :] & tb.thp) != 0, axis=-1)
         viable_t = viable_nogate & (st.n_claims < N)
         slot = jnp.argmin(jnp.where(viable_t, jnp.arange(T), INF_I))
         # a viable template exists but every claim slot is taken: the host
@@ -746,6 +765,23 @@ def _step(tb: Tables, st: State, x: PodX):
     v_cnt, h_cnt = _record(
         st.v_cnt, st.h_cnt, final_rec, slot_global, allow_wk, pred, x, tb
     )
+    if HPW:
+        # record host-port usage on the chosen slot; a fresh claim also
+        # inherits its template's daemonset ports
+        hp_add = x.hp_own | jnp.where(
+            kind == KIND_NEW,
+            tb.thp[jnp.clip(slot_t, 0, max(T - 1, 0))],
+            jnp.zeros(HPW, jnp.uint32),
+        )
+        hp_used = st.hp_used.at[slot_global].set(
+            jnp.where(
+                pred,
+                st.hp_used[slot_global] | hp_add,
+                st.hp_used[slot_global],
+            )
+        )
+    else:
+        hp_used = st.hp_used
 
     new_state = State(
         active=active,
@@ -764,6 +800,7 @@ def _step(tb: Tables, st: State, x: PodX):
         h_cnt=h_cnt,
         rescap=rescap,
         held=held,
+        hp_used=hp_used,
     )
     out_slot = jnp.where(
         kind == KIND_EXISTING,

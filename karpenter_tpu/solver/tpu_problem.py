@@ -116,6 +116,12 @@ class EncodedProblem:
     num_reservations: int = 0
     rid_names: list[str] = field(default_factory=list)  # [NRES]
     rescap0: Optional[np.ndarray] = None  # [NRES] i32 initial capacities
+    # host ports (hostportusage.go:35; round 5): HP distinct triples
+    num_host_ports: int = 0
+    php_own_c: Optional[np.ndarray] = None  # [NC, HPW] u32 own triple bits
+    php_conf_c: Optional[np.ndarray] = None  # [NC, HPW] u32 conflict mask
+    thp: Optional[np.ndarray] = None  # [T, HPW] daemonset port seeds
+    ehp: Optional[np.ndarray] = None  # [E, HPW] existing-node usage seeds
 
     # existing nodes [E]
     ereq: Optional[Reqs] = None
@@ -210,11 +216,12 @@ def pod_unsupported_reason(
     step attempts them in order (tpu_kernel._step_relax mirrors
     scheduler.go:434 trySchedule's inline relax-on-a-copy), so preferred
     affinities, ScheduleAnyway TSCs, and required OR-terms are no longer
-    fallback reasons. What remains gated: host ports, volume claims,
-    hostname requirements (a node IS its hostname slot — no vocab id), and
+    fallback reasons. Round 5: host ports ride the kernel too — the
+    distinct (ip, proto, port) triples become bit positions, conflicts a
+    precomputed relation mask, and per-slot usage a State bitmask
+    (hostportusage.go:35). What remains gated: volume claims, hostname
+    requirements (a node IS its hostname slot — no vocab id), and
     pathologically long ladders."""
-    if pod.host_ports:
-        return "pod host ports"
     if pod.volume_claims:
         return "pod volume claims"
     if well_known.HOSTNAME_LABEL_KEY in pod.node_selector:
@@ -908,9 +915,80 @@ def _encode_pod_classes(
         for c, i in enumerate(reps):
             p.ptol_e_c[c, e] = tolerates(node.cached_taints, pods[i])
 
-    # host-port conflicts are gated off; see _check_pod_supported
-    for i in reps:
-        assert not get_host_ports(pods[i])
+    # ---- host ports (hostportusage.go:35; round 5) ---------------------
+    # universe = every distinct (ip, proto, port) triple observed on pods,
+    # template daemonsets, and existing nodes; conflict is a precomputed
+    # RELATION over triples (same proto+port, ips equal or either
+    # wildcard), so the kernel's screen is one mask AND per candidate
+    triples: dict = {}
+
+    def intern(hp):
+        got = triples.get(hp)
+        if got is None:
+            got = len(triples)
+            triples[hp] = got
+        return got
+
+    class_ports = [get_host_ports(pods[i]) for i in reps]
+    for ports in class_ports:
+        for hp in ports:
+            intern(hp)
+    tmpl_ports = []
+    for nct in scheduler.templates:
+        usage = scheduler.daemon_host_ports.get(nct)
+        ports = (
+            [hp for plist in usage._by_pod.values() for hp in plist]
+            if usage is not None
+            else []
+        )
+        tmpl_ports.append(ports)
+        for hp in ports:
+            intern(hp)
+    node_ports = []
+    for node in scheduler.existing_nodes:
+        ports = [
+            hp for plist in node.host_port_usage._by_pod.values() for hp in plist
+        ]
+        node_ports.append(ports)
+        for hp in ports:
+            intern(hp)
+    HP = len(triples)
+    HPW = (HP + 31) // 32
+    p.num_host_ports = HP
+    all_triples = list(triples)
+
+    def pack_bits(idxs) -> np.ndarray:
+        out = np.zeros(HPW, np.uint32)
+        for i in idxs:
+            out[i // 32] |= np.uint32(1) << np.uint32(i % 32)
+        return out
+
+    from karpenter_tpu.scheduling.hostports import _conflicts
+
+    conflict_of = [
+        [u for u, hpu in enumerate(all_triples) if _conflicts(hpt, hpu)]
+        for hpt in all_triples
+    ]
+
+    def pack_ports(ports) -> tuple[np.ndarray, np.ndarray]:
+        idxs = [triples[hp] for hp in ports]
+        own = pack_bits(idxs)
+        conf = pack_bits([u for i in idxs for u in conflict_of[i]])
+        return own, conf
+
+    p.php_own_c = np.zeros((NC, HPW), np.uint32)
+    p.php_conf_c = np.zeros((NC, HPW), np.uint32)
+    for c, ports in enumerate(class_ports):
+        if ports:
+            p.php_own_c[c], p.php_conf_c[c] = pack_ports(ports)
+    p.thp = np.zeros((T, HPW), np.uint32)
+    for t, ports in enumerate(tmpl_ports):
+        if ports:
+            p.thp[t] = pack_ports(ports)[0]
+    p.ehp = np.zeros((E, HPW), np.uint32)
+    for e, ports in enumerate(node_ports):
+        if ports:
+            p.ehp[e] = pack_ports(ports)[0]
 
     # topology ownership tables (same groups for every pod of a class: the
     # Topology hashes groups by constraint spec, which the class signature

@@ -45,28 +45,29 @@ def test_supported_problem_uses_tpu_and_matches_oracle():
 
 def test_unsupported_problem_falls_back_without_raising():
     fixtures.reset_rng(7)
-    # host-ports pods stay outside the tensor encoding
-    # (tpu_problem._check_pod_supported); a batch of ONLY unsupported pods
-    # falls back wholesale without raising
+    # volume-claim pods stay outside the tensor encoding
+    # (tpu_problem._check_pod_supported — host ports ride the kernel since
+    # round 5); a batch of ONLY unsupported pods falls back wholesale
+    # without raising
     from karpenter_tpu.solver.oracle import SchedulerOptions
 
     pods = fixtures.make_generic_pods(8)
     for i, p in enumerate(pods):
-        p.host_ports = [("", "TCP", 9000 + i)]
+        p.volume_claims = [f"pvc-{i}"]
     # tpu_min_pods=0 so the UNSUPPORTED fallback (not size routing) is
     # what this test exercises
     h = HybridScheduler(*_problem(pods), options=SchedulerOptions(tpu_min_pods=0))
     results = h.solve(pods)  # must not raise
     assert h.used_tpu is False
     assert h.fallback_reason is not None
-    assert "host ports" in h.fallback_reason
+    assert "volume claims" in h.fallback_reason
     assert not results.pod_errors
 
     # and the fallback result equals a pure-oracle run of the same problem
     fixtures.reset_rng(7)
     pods2 = fixtures.make_generic_pods(8)
     for i, p in enumerate(pods2):
-        p.host_ports = [("", "TCP", 9000 + i)]
+        p.volume_claims = [f"pvc-{i}"]
     want = Scheduler(*_problem(pods2)).solve(pods2)
     assert results.node_pod_counts() == want.node_pod_counts()
 
@@ -112,25 +113,47 @@ def test_force_oracle():
     assert sum(results.node_pod_counts()) + len(results.pod_errors) == len(pods)
 
 
-def test_host_ports_partition_to_oracle():
-    """A host-ports pod rides the oracle continuation while the rest of the
-    batch stays on the kernel (per-pod partitioning; whole-batch fallback
-    was the round-2 cliff)."""
+def test_host_ports_ride_kernel():
+    """Round 5 (VERDICT #6): host-port pods ride the kernel — the distinct
+    (ip, proto, port) triples are bit positions, conflict is a precomputed
+    relation mask, per-slot usage is a State bitmask (hostportusage.go:35).
+    Conflicting pods fork claims exactly as the oracle forks them,
+    including the wildcard-IP rule."""
     from karpenter_tpu.solver.oracle import SchedulerOptions
 
-    fixtures.reset_rng(7)
-    pods = fixtures.make_generic_pods(4)
-    pods[2].host_ports = [("", "TCP", 8080)]
-    # tpu_min_pods=0: this test pins the PARTITIONING behavior, not the
-    # size-based routing (which would send 4 topology-free pods oracle-ward)
-    h = HybridScheduler(*_problem(pods), options=SchedulerOptions(tpu_min_pods=0))
-    results = h.solve(pods)
-    assert h.used_tpu is True
-    assert "host ports" in h.fallback_reason
-    assert "continued on the oracle" in h.fallback_reason
-    assert not results.pod_errors
-    placed = {p.name for c in results.new_node_claims for p in c.pods}
-    assert len(placed) == len(pods)
+    def build(force):
+        fixtures.reset_rng(7)
+        pods = fixtures.make_generic_pods(6)
+        # three pods on the same (proto, port): concrete ip, wildcard,
+        # and a DIFFERENT concrete ip (wildcard conflicts both; the two
+        # concrete ips do not conflict each other)
+        pods[0].host_ports = [("10.0.0.1", "TCP", 8080)]
+        pods[1].host_ports = [("0.0.0.0", "TCP", 8080)]
+        pods[2].host_ports = [("10.0.0.2", "TCP", 8080)]
+        # same port, different protocol: no conflict with any of the above
+        pods[3].host_ports = [("0.0.0.0", "UDP", 8080)]
+        cls = HybridScheduler if not force else Scheduler
+        kw = {"force_oracle": False} if not force else {}
+        opts = SchedulerOptions(tpu_min_pods=0)
+        s = cls(*_problem(pods), options=opts, **kw)
+        return s, s.solve(pods), pods
+
+    h, rt, pods = build(False)
+    assert h.used_tpu is True, h.fallback_reason
+    assert not rt.pod_errors
+    _, ro, _ = build(True)
+
+    def snap(r):
+        return sorted(
+            tuple(sorted(p.name for p in c.pods)) for c in r.new_node_claims
+        )
+
+    assert snap(rt) == snap(ro)
+    # the wildcard pod shares a claim with NO other 8080/TCP pod
+    for c in rt.new_node_claims:
+        names = {p.name for p in c.pods}
+        if "generic-1" in names:
+            assert not ({"generic-0", "generic-2"} & names)
 
 
 def test_mixed_batch_partitions_per_pod():
@@ -164,10 +187,10 @@ def test_mixed_batch_partitions_per_pod():
             )
         ],
     )
-    # a host-ports pod still partitions; the former relaxable partition
-    # case now rides the kernel's tier ladder (asserted separately below)
+    # a volume-claims pod still partitions; the former relaxable and
+    # host-port partition cases now ride the kernel
     ported = fixtures.pod(name="ported", requests={"cpu": "100m"})
-    ported.host_ports = [("", "TCP", 8080)]
+    ported.volume_claims = ["pvc-ported"]
     pods.append(relaxable)
     pods.append(ported)
     topo = Topology([pool], {"default": its}, pods)
@@ -175,7 +198,7 @@ def test_mixed_batch_partitions_per_pod():
     r = s.solve(pods)
     assert s.used_tpu is True, s.fallback_reason
     assert s.fallback_reason and "continued on the oracle" in s.fallback_reason
-    assert "host ports" in s.fallback_reason
+    assert "volume claims" in s.fallback_reason
     assert not r.pod_errors, r.pod_errors
     placed = {p.name for c in r.new_node_claims for p in c.pods}
     assert "anyway" in placed and "ported" in placed
@@ -331,9 +354,9 @@ def test_partition_with_nodepool_limits_matches_oracle():
         its = _universe()
         pool = fixtures.node_pool(name="default", limits={"cpu": "24"})
         pods = fixtures.make_generic_pods(12)
-        # one host-ports pod forces the partitioned continuation
+        # one volume-claims pod forces the partitioned continuation
         hp = fixtures.pod(name="hp", requests={"cpu": "100m"})
-        hp.host_ports = [("", "TCP", 8080)]
+        hp.volume_claims = ["pvc-hp"]
         pods.append(hp)
         topo = Topology([pool], {"default": its}, pods)
         return pool, its, topo, pods

@@ -482,3 +482,125 @@ def test_single_step_overflow_pod_is_retried_not_failed():
     ro = Scheduler([pool], {"default": its}, topo2).solve(pods2)
     assert len(rt.pod_errors) == len(ro.pod_errors) == 0
     assert len(rt.new_node_claims) == len(ro.new_node_claims) == 70
+
+
+def test_host_ports_with_existing_nodes_and_claim_reuse():
+    """Host-port usage seeds from existing nodes screen candidates, and
+    committed ports accumulate on claim slots (hostportusage.go:35) —
+    placements bit-identical to the oracle."""
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver.nodes import StateNodeView
+    from karpenter_tpu.solver.oracle import Scheduler, SchedulerOptions
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import TpuScheduler
+    from karpenter_tpu.testing import fixtures
+    from karpenter_tpu.api import labels as wk
+
+    its = construct_instance_types(sizes=[2, 8])
+
+    def make_view():
+        v = StateNodeView(
+            name="existing-1",
+            labels={
+                wk.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a",
+                wk.HOSTNAME_LABEL_KEY: "existing-1",
+                wk.INSTANCE_TYPE_LABEL_KEY: "c-2x-amd64-linux",
+                wk.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+                wk.OS_LABEL_KEY: "linux",
+                wk.ARCH_LABEL_KEY: "amd64",
+                wk.NODEPOOL_LABEL_KEY: "default",
+            },
+            available={"cpu": 1800, "memory": 3 * 1024**3 * 1000, "pods": 100},
+            capacity={"cpu": 2000, "memory": 4 * 1024**3 * 1000},
+            initialized=True,
+        )
+        # the node already serves 443/TCP on the wildcard ip
+        squatter = fixtures.pod(name="squatter")
+        v.host_port_usage.add(squatter, [("0.0.0.0", "TCP", 443)])
+        return v
+
+    def solve(cls, **kw):
+        fixtures.reset_rng(13)
+        pods = [
+            fixtures.pod(name="wants-443", requests={"cpu": "100m"}),
+            fixtures.pod(name="plain", requests={"cpu": "100m"}),
+            fixtures.pod(name="wants-443-too", requests={"cpu": "100m"}),
+        ]
+        pods[0].host_ports = [("", "TCP", 443)]
+        pods[2].host_ports = [("10.1.1.1", "TCP", 443)]
+        pool = fixtures.node_pool(name="default")
+        views = [make_view()]
+        topo = Topology([pool], {"default": its}, pods, state_node_views=views)
+        s = cls([pool], {"default": its}, topo, views, None, SchedulerOptions(), **kw)
+        return s.solve(pods)
+
+    rt = solve(TpuScheduler)
+    ro = solve(Scheduler)
+
+    def snap(r):
+        out = {}
+        for n in r.existing_nodes:
+            for p in n.pods:
+                out[p.name] = ("existing", n.view.name)
+        for c in r.new_node_claims:
+            for p in c.pods:
+                out[p.name] = ("new", tuple(sorted(q.name for q in c.pods)))
+        return out
+
+    a, b = snap(rt), snap(ro)
+    assert a == b, (a, b)
+    # the 443/TCP pods must avoid the existing node (wildcard squatter)
+    assert a["wants-443"][0] == "new"
+    assert a["wants-443-too"][0] == "new"
+    assert not rt.pod_errors and not ro.pod_errors
+
+
+def test_daemonset_host_ports_force_per_pod_path_and_match_oracle():
+    """A template whose daemonset claims a host port disables the bulk
+    phases (bulk-created claims would miss the thp seed); a later
+    host-port pod must refuse the daemonset's port on every claim, same
+    as the oracle (hostportusage.go:35)."""
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver.oracle import Scheduler, SchedulerOptions
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import TpuScheduler
+    from karpenter_tpu.testing import fixtures
+
+    its = construct_instance_types(sizes=[8])
+
+    def solve(cls, **kw):
+        fixtures.reset_rng(17)
+        daemon = fixtures.pod(name="ds-proxy", requests={"cpu": "100m"})
+        daemon.host_ports = [("0.0.0.0", "TCP", 443)]
+        pods = [
+            fixtures.pod(name=f"w-{i}", requests={"cpu": "500m"})
+            for i in range(6)
+        ]
+        clash = fixtures.pod(name="clash", requests={"cpu": "100m"})
+        clash.host_ports = [("", "TCP", 443)]
+        pods.append(clash)
+        pool = fixtures.node_pool(name="default")
+        topo = Topology([pool], {"default": its}, pods)
+        s = cls(
+            [pool], {"default": its}, topo, None, [daemon],
+            SchedulerOptions(), **kw,
+        )
+        return s.solve(pods), {p.uid: p.name for p in pods}
+
+    rt, rt_names = solve(TpuScheduler)
+    ro, ro_names = solve(Scheduler)
+
+    def snap(r):
+        return {
+            p.name: tuple(sorted(q.name for q in c.pods))
+            for c in r.new_node_claims
+            for p in c.pods
+        }
+
+    assert snap(rt) == snap(ro)
+    # the clash pod conflicts with EVERY claim's daemonset port: it must
+    # be unschedulable on both paths (compare by NAME — each run builds
+    # its own pod objects with fresh uids)
+    errs_t = {rt_names[u] for u in rt.pod_errors}
+    errs_o = {ro_names[u] for u in ro.pod_errors}
+    assert errs_t == errs_o == {"clash"}
