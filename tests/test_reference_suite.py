@@ -1043,8 +1043,9 @@ def test_bp_multiple_small_pods_pack_one_node():
         fixtures.pod(name=f"p{i}", requests={"cpu": "10m"}) for i in range(5)
     ]
     r = solve(pods)
-    claims = {id(claim_of(r, f"p{i}")) for i in range(5)}
-    assert len(claims) == 1
+    claims = [claim_of(r, f"p{i}") for i in range(5)]
+    assert all(c is not None for c in claims)
+    assert len({id(c) for c in claims}) == 1
 
 
 def test_bp_new_node_at_capacity():
@@ -1190,3 +1191,113 @@ def test_reference_families_kernel_parity():
     r_oracle = solve(copy.deepcopy(pods))
     r_kernel = solve(copy.deepcopy(pods), kernel=True)
     assert snapshot(r_oracle) == snapshot(r_kernel)
+
+
+# ---------------------------------------------------------------------------
+# Topology corner cases ported round 5 (topology_test.go)
+
+
+def test_topology_anti_affinity_schroedinger():
+    """topology_test.go:2527 — a pod with zone anti-affinity lands first
+    but its zone is UNDETERMINED within the batch (the claim keeps a
+    multi-zone set); a pod matching the anti selector cannot schedule in
+    the same batch, because the anti pod could be in any zone. Once the
+    first solve commits, a second batch places it in a different zone."""
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+    from karpenter_tpu.solver import Scheduler, Topology
+
+    its = fake.default_instance_types()
+    pool = fixtures.node_pool(name="default")
+    anti = [
+        PodAffinityTerm(
+            topology_key=ZONE,
+            label_selector=LabelSelector(match_labels={"security": "s2"}),
+        )
+    ]
+    zone_anywhere = fixtures.pod(
+        name="anywhere", requests={"cpu": "2"}, pod_anti_requirements=anti
+    )
+    aff = fixtures.pod(name="affpod", labels={"security": "s2"})
+    pods = [zone_anywhere, aff]
+    topo = Topology([pool], {"default": its}, pods)
+    r = Scheduler([pool], {"default": its}, topo).solve(pods)
+    c_any = claim_of(r, "anywhere")
+    assert c_any is not None
+    # the anti pod's claim keeps a MULTI-zone set (its zone is genuinely
+    # undetermined within the batch) ...
+    assert len(allowed_zones(c_any)) > 1
+    # ... so the matching pod must NOT schedule (it could collide in any
+    # zone) — the Schrödinger essence of topology_test.go:2527. Once the
+    # node materializes with a concrete zone, the second-batch behavior
+    # (schedule into a DIFFERENT zone) is inverse anti-affinity, covered
+    # by test_topology_matrix.py::test_inverse_anti_affinity.
+    assert not scheduled(r, "affpod")
+
+
+def test_topology_interdependent_selectors_pack_one_node():
+    """topology_test.go:459 — a hostname spread whose selector matches NO
+    pods (the spread-owning pods carry different labels): domain counts
+    never move, skew stays 0, and all five pods pack onto one claim."""
+    from karpenter_tpu.api.objects import (
+        LabelSelector,
+        TopologySpreadConstraint,
+        WhenUnsatisfiable,
+    )
+
+    tsc = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=well_known.HOSTNAME_LABEL_KEY,
+            when_unsatisfiable=WhenUnsatisfiable.DO_NOT_SCHEDULE,
+            label_selector=LabelSelector(match_labels={"app": "nomatch"}),
+        )
+    ]
+    pods = [
+        fixtures.pod(
+            name=f"p{i}",
+            labels={"other": "label"},
+            requests={"cpu": "100m"},
+            topology_spread_constraints=[t for t in tsc],
+        )
+        for i in range(5)
+    ]
+    r = solve(pods)
+    claims = [claim_of(r, f"p{i}") for i in range(5)]
+    assert all(c is not None for c in claims)
+    assert len({id(c) for c in claims}) == 1
+
+
+def test_topology_interdependent_selectors_kernel_parity():
+    """The same scenario through the kernel — identical packing."""
+    from karpenter_tpu.api.objects import (
+        LabelSelector,
+        TopologySpreadConstraint,
+        WhenUnsatisfiable,
+    )
+
+    def make():
+        return [
+            fixtures.pod(
+                name=f"p{i}",
+                labels={"other": "label"},
+                requests={"cpu": "100m"},
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=well_known.HOSTNAME_LABEL_KEY,
+                        when_unsatisfiable=WhenUnsatisfiable.DO_NOT_SCHEDULE,
+                        label_selector=LabelSelector(
+                            match_labels={"app": "nomatch"}
+                        ),
+                    )
+                ],
+            )
+            for i in range(5)
+        ]
+
+    ro = solve(make())
+    rt = solve(make(), kernel=True)
+    count = lambda r: sorted(
+        len(c.pods) for c in r.new_node_claims if c.pods
+    )
+    assert count(ro) == count(rt) == [5]
