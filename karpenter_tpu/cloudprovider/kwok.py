@@ -164,6 +164,11 @@ class KwokCloudProvider:
         self.registration_delay = registration_delay_seconds
         self.instances: dict[str, object] = {}  # provider id -> NodeClaim view
         self._pending_nodes: list[tuple[float, object]] = []
+        # boot-taint clearing state (reconcile): claim names whose startup
+        # taints still need their one-shot removal, and node names already
+        # cleared (pruned when the instance is deleted)
+        self._boot_pending: set[str] = set()
+        self._boot_cleared: set[str] = set()
         self.next_create_error: Optional[Exception] = None
         self.created: list[object] = []
         self.deleted: list[str] = []
@@ -256,12 +261,20 @@ class KwokCloudProvider:
         self._pending_nodes.append(
             (self.clock.now() + self.registration_delay, node)
         )
+        if claim.startup_taints:
+            self._boot_pending.add(claim.name)
         return claim
 
     def reconcile(self) -> int:
-        """Flush nodes whose registration delay elapsed into the store.
-        Returns how many joined."""
-        from karpenter_tpu.controllers.kube import AlreadyExists
+        """Flush nodes whose registration delay elapsed into the store,
+        and clear each node's STARTUP taints exactly once after it joins —
+        the fabricated analog of the boot daemonset that tolerates and
+        then removes them (nodepool.go:190 startupTaints "expected to be
+        removed automatically within a short period of time"). One-shot:
+        a startup-keyed taint applied LATER sticks, so initialized-node
+        scenarios keep reference semantics (suite_test.go:2145).
+        Returns how many nodes joined."""
+        from karpenter_tpu.controllers.kube import AlreadyExists, Conflict, NotFound
 
         now = self.clock.now()
         due = [n for t, n in self._pending_nodes if t <= now]
@@ -275,6 +288,30 @@ class KwokCloudProvider:
                 joined += 1
             except AlreadyExists:
                 pass
+        # boot-taint clearing pass — only while some boot is pending, so
+        # the common zero-startup-taint path pays nothing per tick
+        if self._boot_pending:
+            for claim in self.kube.list("NodeClaim"):
+                if not claim.startup_taints or not claim.status.node_name:
+                    continue
+                name = claim.status.node_name
+                if name in self._boot_cleared:
+                    continue
+                node = self.kube.try_get("Node", name)
+                if node is None:
+                    continue
+                self._boot_cleared.add(name)
+                self._boot_pending.discard(claim.name)
+                boot = {(t.key, t.effect) for t in claim.startup_taints}
+                kept = [t for t in node.taints if (t.key, t.effect) not in boot]
+                if len(kept) != len(node.taints):
+                    node.taints = kept
+                    try:
+                        self.kube.update("Node", node)
+                    except (Conflict, NotFound):
+                        # retry next tick
+                        self._boot_cleared.discard(name)
+                        self._boot_pending.add(claim.name)
         return joined
 
     def delete(self, node_claim) -> None:
@@ -286,6 +323,8 @@ class KwokCloudProvider:
             raise NodeClaimNotFoundError(pid)
         del self.instances[pid]
         self.deleted.append(pid)
+        self._boot_pending.discard(node_claim.name)
+        self._boot_cleared.discard(node_claim.status.node_name or node_claim.name)
 
     def get(self, provider_id: str):
         from karpenter_tpu.cloudprovider.types import NodeClaimNotFoundError

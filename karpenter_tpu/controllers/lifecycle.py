@@ -170,9 +170,17 @@ class NodeClaimLifecycle:
             return None
         if not node.ready:
             return None
-        # startup taints must have been removed (initialization.go:46)
-        startup = set(claim.startup_taints)
-        if any(t in startup for t in node.taints):
+        # startup AND known-ephemeral taints must have been removed
+        # (initialization.go:46 StartupTaintsRemoved + :88
+        # KnownEphemeralTaintsRemoved — a not-ready/unreachable node is
+        # not initialized no matter how ready its kubelet claims to be)
+        from karpenter_tpu.scheduling.taints import KNOWN_EPHEMERAL_TAINTS
+
+        blocked = {
+            (t.key, t.effect)
+            for t in list(claim.startup_taints) + list(KNOWN_EPHEMERAL_TAINTS)
+        }
+        if any((t.key, t.effect) in blocked for t in node.taints):
             return None
         # resources registered
         if not node.allocatable:

@@ -129,17 +129,45 @@ class StateNode:
         return {}
 
     def taints(self) -> list[Taint]:
-        """Registered nodes: real node taints minus the bootstrap taints;
-        in-flight claims: spec taints + startup taints (statenode.go:483)."""
+        """Registered nodes: real node taints minus the bootstrap taint.
+
+        UNINITIALIZED nodes that are MANAGED (node_claim present —
+        statenode.go:439 Managed) reject the well-known ephemeral taints
+        (not-ready/unreachable/...) and the claim's startup taints
+        (statenode.go:311-325): those are expected to clear before
+        initialization, so the scheduler assumes pods can land once they
+        do. The same rejection applies to in-flight claims that have no
+        node yet — their startup taints never block scheduling before
+        initialization. After initialization every taint is taken at face
+        value (a re-appearing not-ready then means cordoned); claim-less
+        labeled nodes always are (the reference treats them unmanaged)."""
+        from karpenter_tpu.scheduling.taints import KNOWN_EPHEMERAL_TAINTS
+
+        managed = self.node_claim is not None
+        assume_boot = managed and not self.initialized()
+
+        def reject_boot(taints: list[Taint]) -> list[Taint]:
+            # MatchTaint semantics: key + effect (value ignored)
+            reject = {
+                (t.key, t.effect)
+                for t in list(KNOWN_EPHEMERAL_TAINTS)
+                + list(self.node_claim.startup_taints)
+            }
+            return [t for t in taints if (t.key, t.effect) not in reject]
+
         if self.node is not None and self.registered():
-            return [t for t in self.node.taints if t != UNREGISTERED_TAINT]
-        out: list[Taint] = []
+            taints = [t for t in self.node.taints if t != UNREGISTERED_TAINT]
+            if assume_boot:
+                taints = reject_boot(taints)
+            return taints
         if self.node_claim is not None:
-            out += list(self.node_claim.taints)
-            out += list(self.node_claim.startup_taints)
-        elif self.node is not None:
-            out += list(self.node.taints)
-        return out
+            out = list(self.node_claim.taints) + list(
+                self.node_claim.startup_taints
+            )
+            return reject_boot(out) if assume_boot else out
+        if self.node is not None:
+            return list(self.node.taints)
+        return []
 
     def capacity(self) -> ResourceList:
         if self.node is not None and self.node.capacity:
@@ -588,7 +616,17 @@ class Cluster:
     def schedulable_node_views(self) -> list[StateNodeView]:
         """The ExistingNode inputs for a provisioning Solve: registered,
         not deleting, not marked for deletion (scheduler.go existing-node
-        selection)."""
+        selection).
+
+        KNOWN REDUCTION vs the reference: claim-only StateNodes (launched,
+        node not yet registered) are excluded — the reference also feeds
+        those to the scheduler as in-flight capacity. Here the window is
+        the provider's registration delay (~2s sim time) and pods arriving
+        INSIDE one batch share in-flight claims within the solve itself;
+        pods arriving across batches during the window can fork an extra
+        claim the reference would have packed. StateNode.taints() already
+        implements the uninitialized-claim taint semantics this path would
+        need (statenode.go:311-325)."""
         out = []
         for sn in self.nodes.values():
             if sn.marked_for_deletion or sn.deleting():

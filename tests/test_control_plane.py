@@ -588,3 +588,124 @@ def test_per_driver_csi_volume_limits():
     sn = op.cluster.node_by_name(bound.node_name)
     vols = sn.volume_usage.distinct_volumes()
     assert ("ebs.csi", "data") in vols, vols
+
+
+def test_ephemeral_taint_assumed_schedulable_until_initialized():
+    """suite_test.go:2042 — node.kubernetes.io/not-ready:NoExecute on an
+    UNINITIALIZED managed node is ephemeral: the scheduler assumes pods
+    can land there (statenode.go:311 rejects known ephemeral taints until
+    initialization). Once the node is initialized, the same taint is
+    taken at face value and a tolerating-nothing pod provisions a NEW
+    node instead."""
+    from karpenter_tpu.api.objects import (
+        COND_INITIALIZED,
+        Taint,
+        TaintEffect,
+    )
+
+    op = small_operator()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Pod", fixtures.pod(name="first", requests={"cpu": "500m"}))
+    assert op.run_until_settled(max_ticks=40) < 40
+    op.kube.delete("Pod", "first")
+    (node,) = op.kube.list("Node")
+    (claim,) = op.kube.list("NodeClaim")
+
+    # make the node UNINITIALIZED again and not-ready-tainted (the window
+    # between registration and initialization)
+    claim.status.conditions[COND_INITIALIZED] = "False"
+    op.kube.update("NodeClaim", claim)
+    node = op.kube.get("Node", node.name)
+    node.taints = list(node.taints) + [
+        Taint("node.kubernetes.io/not-ready", TaintEffect.NO_EXECUTE)
+    ]
+    op.kube.update("Node", node)
+
+    op.kube.create("Pod", fixtures.pod(name="second", requests={"cpu": "300m"}))
+    for _ in range(6):  # settled() needs initialized claims; step manually
+        op.step(2.0)
+    second = op.kube.get("Pod", "second")
+    assert second.node_name == node.name, (
+        second.node_name,
+        "ephemeral taint must not block an uninitialized node",
+    )
+    assert len(op.kube.list("Node")) == 1
+
+    # initialize the node; the (still present) taint now counts
+    op.kube.delete("Pod", "second")
+    claim = op.kube.get("NodeClaim", claim.name)
+    claim.status.conditions[COND_INITIALIZED] = "True"
+    op.kube.update("NodeClaim", claim)
+    op.kube.create("Pod", fixtures.pod(name="third", requests={"cpu": "300m"}))
+    assert op.run_until_settled(max_ticks=40) < 40
+    third = op.kube.get("Pod", "third")
+    assert third.node_name and third.node_name != node.name, (
+        "a real taint on an initialized node must not be assumed away"
+    )
+
+
+def test_custom_taint_never_assumed_schedulable():
+    """suite_test.go:2080 — a NON-ephemeral taint on a node is never
+    assumed away, initialized or not: the intolerant pod gets a new
+    node."""
+    from karpenter_tpu.api.objects import COND_INITIALIZED, Taint, TaintEffect
+
+    op = small_operator()
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    op.kube.create("Pod", fixtures.pod(name="first", requests={"cpu": "500m"}))
+    assert op.run_until_settled(max_ticks=40) < 40
+    op.kube.delete("Pod", "first")
+    (node,) = op.kube.list("Node")
+    (claim,) = op.kube.list("NodeClaim")
+    claim.status.conditions[COND_INITIALIZED] = "False"  # even uninitialized
+    op.kube.update("NodeClaim", claim)
+    node = op.kube.get("Node", node.name)
+    node.taints = list(node.taints) + [
+        Taint("example.com/custom", TaintEffect.NO_SCHEDULE)
+    ]
+    op.kube.update("Node", node)
+
+    op.kube.create("Pod", fixtures.pod(name="second", requests={"cpu": "300m"}))
+    assert op.run_until_settled(max_ticks=40) < 40
+    second = op.kube.get("Pod", "second")
+    assert second.node_name and second.node_name != node.name
+
+
+def test_startup_taint_assumed_until_initialized():
+    """suite_test.go:2112/2145 — a claim's custom STARTUP taint is
+    assumed removable while the node is uninitialized; after
+    initialization a still-present startup taint blocks like any other."""
+    from karpenter_tpu.api.objects import COND_INITIALIZED, Taint, TaintEffect
+
+    startup = Taint("example.com/boot", TaintEffect.NO_SCHEDULE)
+    op = small_operator()
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="default", startup_taints=[startup]),
+    )
+    op.kube.create("Pod", fixtures.pod(name="first", requests={"cpu": "500m"}))
+    assert op.run_until_settled(max_ticks=40) < 40
+    op.kube.delete("Pod", "first")
+    (node,) = op.kube.list("Node")
+    (claim,) = op.kube.list("NodeClaim")
+
+    # un-initialize + re-apply the startup taint (the boot window)
+    claim.status.conditions[COND_INITIALIZED] = "False"
+    op.kube.update("NodeClaim", claim)
+    node = op.kube.get("Node", node.name)
+    node.taints = list(node.taints) + [startup]
+    op.kube.update("Node", node)
+    op.kube.create("Pod", fixtures.pod(name="second", requests={"cpu": "300m"}))
+    for _ in range(6):  # settled() needs initialized claims; step manually
+        op.step(2.0)
+    assert op.kube.get("Pod", "second").node_name == node.name
+
+    # initialized with the startup taint still on: no longer assumed away
+    op.kube.delete("Pod", "second")
+    claim = op.kube.get("NodeClaim", claim.name)
+    claim.status.conditions[COND_INITIALIZED] = "True"
+    op.kube.update("NodeClaim", claim)
+    op.kube.create("Pod", fixtures.pod(name="third", requests={"cpu": "300m"}))
+    assert op.run_until_settled(max_ticks=40) < 40
+    third = op.kube.get("Pod", "third")
+    assert third.node_name and third.node_name != node.name
