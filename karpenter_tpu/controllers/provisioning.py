@@ -418,10 +418,20 @@ class Provisioner:
         for node in results.existing_nodes:
             if not node.pods:
                 continue
-            sn = self.cluster.node_by_name(node.name)
-            if sn is None or sn.node is None or not sn.node.ready:
+            # in-flight claim-only views resolve by claim name
+            sn = self.cluster.node_by_name(node.name) or (
+                self.cluster.node_by_claim_name(node.name)
+            )
+            if sn is None:
                 continue
             sn.nominate(self.clock.now())
+            if sn.node is None or not sn.node.ready:
+                # in-flight capacity: the placement is a DECISION (keeps
+                # the nomination window fresh + the undecided metric
+                # honest) but binding waits for the node to be ready
+                for pod in node.pods:
+                    assignments[pod.uid] = node.name
+                continue
             for pod in node.pods:
                 stored = self.kube.try_get("Pod", pod.name)
                 if stored is None or not is_provisionable(stored):

@@ -160,13 +160,14 @@ class StateNode:
             if assume_boot:
                 taints = reject_boot(taints)
             return taints
+        # remaining cases all carry a claim: claim-only, or a joined node
+        # that hasn't registered (registered() is True whenever node is
+        # present WITHOUT a claim, so that combination never reaches here)
         if self.node_claim is not None:
             out = list(self.node_claim.taints) + list(
                 self.node_claim.startup_taints
             )
             return reject_boot(out) if assume_boot else out
-        if self.node is not None:
-            return list(self.node.taints)
         return []
 
     def capacity(self) -> ResourceList:
@@ -618,23 +619,30 @@ class Cluster:
         not deleting, not marked for deletion (scheduler.go existing-node
         selection).
 
-        KNOWN REDUCTION vs the reference: claim-only StateNodes (launched,
-        node not yet registered) are excluded — the reference also feeds
-        those to the scheduler as in-flight capacity. Here the window is
-        the provider's registration delay (~2s sim time) and pods arriving
-        INSIDE one batch share in-flight claims within the solve itself;
-        pods arriving across batches during the window can fork an extra
-        claim the reference would have packed. StateNode.taints() already
-        implements the uninitialized-claim taint semantics this path would
-        need (statenode.go:311-325)."""
+        LAUNCHED claim-only StateNodes (no registered node yet) are
+        in-flight capacity exactly as in the reference (cluster.Nodes
+        feeds them to the scheduler): pods placed on them nominate and
+        stay pending until the node registers — _bind_to_existing skips
+        nodes that aren't ready — so a cross-batch pod arriving during
+        the registration window packs onto the in-flight claim instead of
+        forking a second one (suite_test.go:1832). StateNode.taints()
+        rejects their startup/ephemeral taints until initialization
+        (statenode.go:311-325)."""
         out = []
         for sn in self.nodes.values():
             if sn.marked_for_deletion or sn.deleting():
                 continue
-            if not sn.registered():
+            registered_node = sn.node is not None and sn.registered()
+            # in-flight capacity: a LAUNCHED claim (capacity known) counts
+            # whether its node hasn't appeared yet OR has joined but not
+            # registered — both are the same window to the scheduler
+            launched_claim = (
+                sn.node_claim is not None
+                and bool(sn.node_claim.status.provider_id)
+                and bool(sn.node_claim.status.allocatable)
+            )
+            if not registered_node and not launched_claim:
                 continue
-            if sn.node is None:
-                continue  # claims without a node can't take pods yet
             out.append(sn.to_view())
         return out
 

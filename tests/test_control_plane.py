@@ -709,3 +709,36 @@ def test_startup_taint_assumed_until_initialized():
     assert op.run_until_settled(max_ticks=40) < 40
     third = op.kube.get("Pod", "third")
     assert third.node_name and third.node_name != node.name
+
+
+def test_inflight_claim_takes_cross_batch_pods():
+    """suite_test.go:1832 — a pod arriving while a launched claim is still
+    in its registration window packs onto the IN-FLIGHT claim (nominate,
+    stay pending, bind once the node registers) instead of forking a
+    second node. Round 5: claim-only StateNodes are schedulable views."""
+    from karpenter_tpu.api.objects import Taint, TaintEffect
+
+    op = small_operator()
+    op.raw_cloud.registration_delay = 30.0  # hold the claim in flight
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(
+            name="default",
+            startup_taints=[Taint("example.com/boot", TaintEffect.NO_SCHEDULE)],
+        ),
+    )
+    op.kube.create("Pod", fixtures.pod(name="a", requests={"cpu": "300m"}))
+    op.step(2.0)
+    assert len(op.kube.list("NodeClaim")) == 1
+    assert not op.kube.list("Node")  # still in the registration window
+
+    op.kube.create("Pod", fixtures.pod(name="b", requests={"cpu": "300m"}))
+    op.step(2.0)
+    op.step(2.0)
+    assert len(op.kube.list("NodeClaim")) == 1, (
+        "cross-batch pod must reuse the in-flight claim"
+    )
+    # once the node registers, both pods bind to the single node
+    assert op.run_until_settled(max_ticks=60) < 60
+    assert len(op.kube.list("Node")) == 1
+    assert all(p.node_name for p in op.kube.list("Pod"))
