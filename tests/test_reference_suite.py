@@ -1301,3 +1301,125 @@ def test_topology_interdependent_selectors_kernel_parity():
         len(c.pods) for c in r.new_node_claims if c.pods
     )
     assert count(ro) == count(rt) == [5]
+
+
+def test_self_affinity_first_empty_domain_only_hostname():
+    """topology_test.go:2065 — 10 pods with self pod-affinity on hostname:
+    they must all co-locate, the fake types hold 5 pods per node, so ONE
+    claim takes 5 and the other 5 are unschedulable (opening a second
+    hostname would break the affinity to the first)."""
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+
+    aff = {"security": "s2"}
+
+    def make():
+        return [
+            fixtures.pod(
+                name=f"sa-{i}",
+                labels=dict(aff),
+                pod_requirements=[
+                    PodAffinityTerm(
+                        topology_key=well_known.HOSTNAME_LABEL_KEY,
+                        label_selector=LabelSelector(match_labels=dict(aff)),
+                    )
+                ],
+            )
+            for i in range(10)
+        ]
+
+    r = solve(make())
+    claims = [c for c in r.new_node_claims if c.pods]
+    assert len(claims) == 1
+    assert len(claims[0].pods) == 5  # fake types: 5 pods per node
+    assert len(r.pod_errors) == 5
+
+
+def _ct_spread_pods(when, n=5):
+    from karpenter_tpu.api.objects import (
+        LabelSelector,
+        TopologySpreadConstraint,
+    )
+
+    return [
+        fixtures.pod(
+            name=f"ct-{i}",
+            labels={"app": "ct"},
+            requests={"cpu": "100m"},
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=well_known.CAPACITY_TYPE_LABEL_KEY,
+                    when_unsatisfiable=when,
+                    label_selector=LabelSelector(match_labels={"app": "ct"}),
+                )
+            ],
+        )
+        for i in range(n)
+    ]
+
+
+def _spot_seeded_problem(pods):
+    """An existing SPOT node holding one matching pod (the spot domain has
+    count 1) + an on-demand-only pool — the reference's setup for the
+    unsatisfiable capacity-type skew (topology_test.go:683-748)."""
+    from karpenter_tpu.solver.topology import ClusterSource
+
+    its = fake.default_instance_types()
+    pool = fixtures.node_pool(
+        name="default",
+        requirements=[
+            NodeSelectorRequirement(
+                well_known.CAPACITY_TYPE_LABEL_KEY, Operator.IN, ["on-demand"]
+            )
+        ],
+    )
+    from karpenter_tpu.api.objects import Node, ObjectMeta
+
+    spot_labels = {
+        well_known.CAPACITY_TYPE_LABEL_KEY: "spot",
+        ZONE: "test-zone-1",
+        well_known.HOSTNAME_LABEL_KEY: "spot-node",
+        well_known.INSTANCE_TYPE_LABEL_KEY: "default-instance-type",
+        well_known.OS_LABEL_KEY: "linux",
+        well_known.ARCH_LABEL_KEY: "amd64",
+    }
+    seeded = fixtures.pod(name="seed", labels={"app": "ct"})
+    seeded.node_name = "spot-node"
+    spot_node = Node(
+        metadata=ObjectMeta(name="spot-node", labels=dict(spot_labels)),
+        ready=True,
+    )
+    cluster = ClusterSource(
+        pods_by_namespace={"default": [seeded]},
+        nodes_by_name={"spot-node": spot_node},
+    )
+    topo = Topology(
+        [pool], {"default": its}, pods, cluster=cluster
+    )
+    return Scheduler([pool], {"default": its}, topo)
+
+
+def test_capacity_type_spread_schedule_anyway_violates():
+    """topology_test.go:718 — a SPOT domain already holds one matching pod
+    but the pool is on-demand-only: the (1, 5) skew is unavoidable.
+    ScheduleAnyway relaxes and everything schedules on-demand."""
+    from karpenter_tpu.api.objects import WhenUnsatisfiable
+
+    pods = _ct_spread_pods(WhenUnsatisfiable.SCHEDULE_ANYWAY)
+    r = _spot_seeded_problem(pods).solve(pods)
+    assert all(scheduled(r, f"ct-{i}") for i in range(5))
+    for i in range(5):
+        c = claim_of(r, f"ct-{i}")
+        assert claim_value(c, well_known.CAPACITY_TYPE_LABEL_KEY) == "on-demand"
+
+
+def test_capacity_type_spread_do_not_schedule_blocks():
+    """topology_test.go:683 — the same setup with DoNotSchedule: only ONE
+    more pod may join the on-demand domain (skew 1 vs the spot domain's
+    1); the rest are unschedulable."""
+    from karpenter_tpu.api.objects import WhenUnsatisfiable
+
+    pods = _ct_spread_pods(WhenUnsatisfiable.DO_NOT_SCHEDULE)
+    r = _spot_seeded_problem(pods).solve(pods)
+    placed = [i for i in range(5) if scheduled(r, f"ct-{i}")]
+    assert len(placed) == 2, (placed, r.pod_errors)
