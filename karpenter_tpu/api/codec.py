@@ -43,6 +43,7 @@ _register(
     api.PodAffinityTerm,
     api.WeightedPodAffinityTerm,
     api.TopologySpreadConstraint,
+    api.Container,
     api.Pod,
     api.Node,
     api.Budget,

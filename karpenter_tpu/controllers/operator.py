@@ -60,6 +60,7 @@ class Operator:
         cloud_provider=None,
         options: Optional[Options] = None,
         force_oracle: bool = False,
+        solver=None,
     ):
         self.clock = clock or FakeClock()
         self.opts = options or Options()
@@ -94,6 +95,9 @@ class Operator:
             self.opts,
             self.recorder,
             force_oracle=force_oracle,
+            # optional ResilientSolver: route solves through the sidecar
+            # boundary with the in-process ladder as the floor
+            solver=solver,
         )
         self.lifecycle = NodeClaimLifecycle(
             self.kube, self.cluster, self.cloud, self.clock, self.opts, self.recorder

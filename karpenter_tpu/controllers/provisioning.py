@@ -33,7 +33,8 @@ from karpenter_tpu.controllers.kube import NotFound, SimKube
 from karpenter_tpu.controllers.state import Cluster, cluster_source, is_provisionable, is_reschedulable
 from karpenter_tpu.events import Event, Recorder
 from karpenter_tpu.options import Options
-from karpenter_tpu.solver import HybridScheduler, Results, SchedulerOptions, Topology
+from karpenter_tpu.solver import Results, SchedulerOptions
+from karpenter_tpu.solver.hybrid import solve_in_process
 from karpenter_tpu.utils import resources as res
 
 # -- scheduler metrics (reference scheduling/metrics.go:34-95) ---------------
@@ -206,6 +207,7 @@ class Provisioner:
         options: Optional[Options] = None,
         recorder: Optional[Recorder] = None,
         force_oracle: bool = False,
+        solver=None,
     ):
         self.kube = kube
         self.cluster = cluster
@@ -220,6 +222,11 @@ class Provisioner:
             self.opts.batch_max_duration_seconds,
         )
         self.force_oracle = force_oracle
+        # Optional sidecar boundary: a ResilientSolver (solver/hybrid.py).
+        # When set, Schedule routes solves through it — remote sidecar
+        # under a circuit breaker, in-process HybridScheduler as the floor.
+        # None = solve in-process directly (tests, benchmarks, default).
+        self.solver = solver
         self.log = logging.root.named("provisioner")
         self.last_solver_used: Optional[str] = None
 
@@ -351,31 +358,50 @@ class Provisioner:
             self.volume_topology.inject(p)  # provisioner.go:286
         views = self.cluster.schedulable_node_views()
 
-        topology = Topology(
+        scheduler_options = SchedulerOptions(
+            ignore_preferences=self.opts.preference_policy == "Ignore",
+            min_values_best_effort=self.opts.min_values_policy == "BestEffort",
+            reserved_capacity_enabled=self.opts.feature_gates.reserved_capacity,
+            timeout_seconds=self.opts.solve_timeout_seconds,
+            claim_slot_div=self.opts.tpu_claim_slot_div,
+            tpu_min_pods=self.opts.tpu_min_pods,
+        )
+        source = cluster_source(self.kube, self.cluster)
+
+        if self.solver is not None:
+            # The resilient sidecar boundary: remote solve under a circuit
+            # breaker, in-process ladder as the floor. Never raises for
+            # solver-side faults — every pending pod gets a decision (or a
+            # pod_error) in THIS reconcile (ISSUE acceptance).
+            results = self.solver.solve(
+                node_pools,
+                its_by_pool,
+                pods,
+                state_node_views=views,
+                daemonset_pods=daemonset_pods,
+                options=scheduler_options,
+                cluster=source,
+                force_oracle=self.force_oracle,
+            )
+            self.last_solver_used = self.solver.last_used
+            if self.solver.fallback_reason:
+                self.log.info(
+                    "solver degraded",
+                    reason=self.solver.fallback_reason,
+                    solver=self.last_solver_used,
+                )
+            return results
+
+        results, scheduler = solve_in_process(
             node_pools,
             its_by_pool,
             pods,
-            cluster=cluster_source(self.kube, self.cluster),
-            state_node_views=views,
-            ignore_preferences=self.opts.preference_policy == "Ignore",
-        )
-        scheduler = HybridScheduler(
-            node_pools,
-            its_by_pool,
-            topology,
             views,
             daemonset_pods,
-            SchedulerOptions(
-                ignore_preferences=self.opts.preference_policy == "Ignore",
-                min_values_best_effort=self.opts.min_values_policy == "BestEffort",
-                reserved_capacity_enabled=self.opts.feature_gates.reserved_capacity,
-                timeout_seconds=self.opts.solve_timeout_seconds,
-                claim_slot_div=self.opts.tpu_claim_slot_div,
-                tpu_min_pods=self.opts.tpu_min_pods,
-            ),
+            scheduler_options,
+            cluster=source,
             force_oracle=self.force_oracle,
         )
-        results = scheduler.solve(pods)
         self.last_solver_used = "tpu" if scheduler.used_tpu else "oracle"
         return results
 

@@ -8,15 +8,23 @@ TPU solver behind a common interface.
 - `topology`: topology-spread / pod-affinity / anti-affinity tracking.
 - `tpu`: the batched JAX solver (see karpenter_tpu.ops for the kernels).
 - `hybrid`: the HybridScheduler dispatch — TPU path with oracle fallback on
-  UnsupportedBySolver; the entry point for controllers and benchmarks.
+  UnsupportedBySolver; the entry point for controllers and benchmarks. Also
+  the resilient sidecar boundary: ResilientSolver + CircuitBreaker
+  (docs/resilience.md failure ladder).
 """
 
-from karpenter_tpu.solver.hybrid import HybridScheduler
+from karpenter_tpu.solver.hybrid import (
+    CircuitBreaker,
+    HybridScheduler,
+    ResilientSolver,
+)
 from karpenter_tpu.solver.oracle import Results, Scheduler, SchedulerOptions
 from karpenter_tpu.solver.topology import Topology
 
 __all__ = [
+    "CircuitBreaker",
     "HybridScheduler",
+    "ResilientSolver",
     "Results",
     "Scheduler",
     "SchedulerOptions",

@@ -18,19 +18,67 @@ The fallback taxonomy (what routes to the oracle) is documented in
 tpu_problem.pod_unsupported_reason: host ports, volume claims, hostname
 requirements, over-long relaxation ladders — plus the whole-problem gates
 (reserved capacity). Preference relaxation rides the kernel since round 4.
+
+Since the fault-tolerance PR this module also carries the top of the
+failure ladder (docs/resilience.md):
+
+    sidecar solve -> [breaker] -> in-process TPU -> [guard] -> oracle
+
+- ResilientSolver wraps the sidecar boundary (solver/service.py) with a
+  circuit breaker: after `failure_threshold` consecutive sidecar failures
+  the breaker opens and solves run in-process for `cooldown_seconds`,
+  then a half-open probe decides whether to close again. Breaker state
+  and every fallback are recorded through karpenter_tpu.metrics.
+- HybridScheduler.solve gains a last-resort guard: an UNEXPECTED error on
+  the TPU path (anything beyond the typed UnsupportedBySolver taxonomy)
+  degrades to a pristine oracle solve — fresh Topology, fresh Scheduler,
+  untouched by whatever half-mutated state the failed kernel attempt left
+  behind — instead of propagating out of the reconcile loop.
 """
 
 from __future__ import annotations
 
+import copy
+import time
+from dataclasses import dataclass, field
 from typing import Optional
 
+from karpenter_tpu import logging as klog
+from karpenter_tpu import metrics
 from karpenter_tpu.api.objects import NodePool, Pod
 from karpenter_tpu.cloudprovider.types import InstanceTypes
 from karpenter_tpu.solver.nodes import StateNodeView
 from karpenter_tpu.solver.oracle import Results, Scheduler, SchedulerOptions
-from karpenter_tpu.solver.topology import Topology
+from karpenter_tpu.solver.topology import ClusterSource, Topology
 from karpenter_tpu.solver.tpu import TpuScheduler
 from karpenter_tpu.solver.tpu_problem import UnsupportedBySolver
+
+# -- resilience metrics (reference pkg/metrics idiom) -------------------------
+
+SOLVER_FALLBACK = metrics.REGISTRY.counter(
+    "karpenter_solver_fallback_total",
+    "Solves that degraded down the failure ladder, by reason.",
+    ("reason",),
+)
+SIDECAR_REQUESTS = metrics.REGISTRY.counter(
+    "karpenter_solver_sidecar_requests_total",
+    "Sidecar solve attempts, by outcome.",
+    ("outcome",),
+)
+BREAKER_STATE = metrics.REGISTRY.gauge(
+    "karpenter_solver_breaker_state",
+    "Sidecar circuit-breaker state (0 closed, 1 half-open, 2 open).",
+    ("breaker",),
+)
+
+_BREAKER_STATE_CODES = {"closed": 0.0, "half-open": 1.0, "open": 2.0}
+
+# Slack added on top of the server-side solve budget when deriving the
+# client's wire deadline: covers serialization + transfer + scheduling
+# jitter so a solve using its FULL budget still answers in time.
+SOLVE_DEADLINE_GRACE_SECONDS = 15.0
+
+_log = klog.root.named("solver")
 
 
 class HybridScheduler:
@@ -55,6 +103,13 @@ class HybridScheduler:
         self.force_oracle = force_oracle
         self.used_tpu: Optional[bool] = None
         self.fallback_reason: Optional[str] = None
+        # kept for the last-resort guard: a pristine oracle re-solve needs
+        # the raw inputs, not the possibly half-mutated shared state
+        self._node_pools = node_pools
+        self._its_by_pool = instance_types_by_pool
+        self._state_nodes = state_nodes
+        self._daemonset_pods = daemonset_pods
+        self._topology = topology
         if force_oracle:
             self.tpu: Optional[TpuScheduler] = None
             self.oracle = Scheduler(
@@ -135,6 +190,24 @@ class HybridScheduler:
             self.fallback_reason = str(e)
             self.used_tpu = False
             return self.oracle.solve(pods)
+        except Exception as e:
+            # Last-resort guard (ISSUE: no unexpected TPU-path error may
+            # propagate out of the reconcile loop). Unlike the typed
+            # UnsupportedBySolver — which is raised before any mutation —
+            # an arbitrary failure may have left the shared oracle/topology
+            # half-written, so degrade onto PRISTINE state.
+            self.used_tpu = False
+            self.fallback_reason = (
+                f"unexpected TPU-path error, degraded to oracle: "
+                f"{type(e).__name__}: {e}"
+            )
+            SOLVER_FALLBACK.inc({"reason": "tpu_error"})
+            _log.error(
+                "TPU path raised unexpectedly; re-solving on a pristine oracle",
+                error=f"{type(e).__name__}: {e}",
+                pods=len(pods),
+            )
+            return self._pristine_oracle_solve(pods)
         self.used_tpu = True
         self.fallback_reason = None
         if not unsupported:
@@ -148,3 +221,324 @@ class HybridScheduler:
         cont.pod_errors.update(results.pod_errors)
         cont.timed_out = cont.timed_out or results.timed_out
         return cont
+
+    def _pristine_oracle_solve(self, pods: list[Pod]) -> Results:
+        """Rebuild Topology + Scheduler from the stored constructor inputs
+        and solve the FULL pod set. The failed kernel attempt may have
+        synced partial claims/domain counts onto the shared oracle; reusing
+        it would double-count. StateNodeViews are read-only to the solve,
+        so they can be shared with the fresh scheduler."""
+        topology = Topology(
+            self._node_pools,
+            self._its_by_pool,
+            pods,
+            cluster=self._topology.cluster,
+            state_node_views=self._state_nodes,
+            ignore_preferences=self.opts.ignore_preferences,
+        )
+        oracle = Scheduler(
+            self._node_pools,
+            self._its_by_pool,
+            topology,
+            self._state_nodes,
+            self._daemonset_pods,
+            self.opts,
+        )
+        self.oracle = oracle  # callers introspect post-solve state here
+        return oracle.solve(pods)
+
+
+def solve_in_process(
+    node_pools: list[NodePool],
+    instance_types_by_pool: dict[str, InstanceTypes],
+    pods: list[Pod],
+    state_node_views: Optional[list[StateNodeView]] = None,
+    daemonset_pods: Optional[list[Pod]] = None,
+    options: Optional[SchedulerOptions] = None,
+    cluster: Optional[ClusterSource] = None,
+    force_oracle: bool = False,
+) -> tuple[Results, HybridScheduler]:
+    """THE in-process solve assembly: Topology + HybridScheduler, options
+    threaded consistently. Every path that solves locally — the
+    provisioning controller, the sidecar server, ResilientSolver's
+    fallback — goes through here, so the three can never diverge on how
+    ignore_preferences / cluster state / views reach the scheduler."""
+    topology = Topology(
+        node_pools,
+        instance_types_by_pool,
+        pods,
+        cluster=cluster or ClusterSource(),
+        state_node_views=state_node_views,
+        ignore_preferences=bool(options and options.ignore_preferences),
+    )
+    scheduler = HybridScheduler(
+        node_pools,
+        instance_types_by_pool,
+        topology,
+        state_node_views,
+        daemonset_pods,
+        options,
+        force_oracle=force_oracle,
+    )
+    return scheduler.solve(pods), scheduler
+
+
+# ---------------------------------------------------------------------------
+# the resilient service boundary (ISSUE: fault-tolerant solver service)
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker for the sidecar boundary.
+
+    closed -> (failure_threshold consecutive failures) -> open
+    open   -> (cooldown_seconds elapse)                -> half-open
+    half-open: one probe rides the sidecar; success -> closed,
+               failure -> open again (fresh cooldown).
+
+    `clock` is a zero-arg seconds source (time.monotonic by default;
+    tests pass FakeClock.now so cooldowns ride simulated time). `name`
+    labels this instance's gauge series — two live breakers (a drained
+    control plane overlapping its successor) must not overwrite each
+    other's exported state."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        cooldown_seconds: float = 30.0,
+        clock=None,
+        name: str = "sidecar",
+    ):
+        self.failure_threshold = failure_threshold
+        self.cooldown_seconds = cooldown_seconds
+        self._clock = clock or time.monotonic
+        self.name = name
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._publish()
+
+    def _publish(self) -> None:
+        BREAKER_STATE.set(
+            _BREAKER_STATE_CODES[self.state], {"breaker": self.name}
+        )
+
+    def allow(self) -> bool:
+        """May the next solve attempt the sidecar?"""
+        if self.state == "closed":
+            return True
+        if self._clock() - self._opened_at >= self.cooldown_seconds:
+            self.state = "half-open"
+            self._publish()
+            return True
+        return False
+
+    def record_success(self) -> None:
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self._opened_at = None
+        self._publish()
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if (
+            self.state == "half-open"
+            or self.consecutive_failures >= self.failure_threshold
+        ):
+            self.state = "open"
+            self._opened_at = self._clock()
+        self._publish()
+
+
+class RemoteNodeClaim:
+    """A new-node decision reconstructed from the wire (service.py RESULT
+    frame). Duck-types the slice of SchedulingNodeClaim the provisioning
+    controller consumes: .pods, .nodepool_name, .requests, .to_node_claim().
+    The launchable NodeClaim itself crossed the wire fully formed — no
+    template state is re-derived client-side."""
+
+    def __init__(self, nodepool_name: str, node_claim, requests, pods: list[Pod]):
+        self.nodepool_name = nodepool_name
+        self._node_claim = node_claim
+        self.requests = dict(requests)
+        self.pods = pods
+
+    def to_node_claim(self):
+        return copy.deepcopy(self._node_claim)
+
+
+@dataclass
+class RemoteExistingNode:
+    """An existing-capacity placement reconstructed from the wire; only
+    .name and .pods are consumed control-plane side (_bind_to_existing)."""
+
+    name: str
+    pods: list[Pod] = field(default_factory=list)
+
+
+class ResilientSolver:
+    """The fault-tolerant entry point the provisioning controller calls
+    when a sidecar is configured: try the remote solver under the circuit
+    breaker, degrade to the in-process HybridScheduler (which itself
+    degrades TPU -> oracle) on ANY sidecar-side failure — a killed sidecar
+    can never stall a reconcile (chaos_test.go:48-90 expects convergence
+    under exactly this churn).
+
+    After solve():
+    - ``last_used``       'sidecar' | 'tpu' | 'oracle'
+    - ``fallback_reason`` why the sidecar was skipped/failed (None when the
+                          sidecar answered)
+    """
+
+    def __init__(
+        self,
+        socket_path: Optional[str] = None,
+        client=None,
+        failure_threshold: int = 3,
+        cooldown_seconds: float = 30.0,
+        request_timeout_seconds: float = 30.0,
+        clock=None,
+        breaker: Optional[CircuitBreaker] = None,
+    ):
+        if client is None:
+            # lazy import: service.py imports HybridScheduler from here
+            from karpenter_tpu.solver.service import SolverClient
+
+            client = SolverClient(socket_path, request_timeout=request_timeout_seconds)
+        self.client = client
+        self.request_timeout_seconds = request_timeout_seconds
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold, cooldown_seconds, clock=clock
+        )
+        self.last_used: Optional[str] = None
+        self.fallback_reason: Optional[str] = None
+        self.log = klog.root.named("solver.resilient")
+
+    def solve(
+        self,
+        node_pools: list[NodePool],
+        instance_types_by_pool: dict[str, InstanceTypes],
+        pods: list[Pod],
+        state_node_views: Optional[list[StateNodeView]] = None,
+        daemonset_pods: Optional[list[Pod]] = None,
+        options: Optional[SchedulerOptions] = None,
+        cluster: Optional[ClusterSource] = None,
+        namespace_labels: Optional[dict] = None,
+        force_oracle: bool = False,
+    ) -> Results:
+        """Never raises for solver-side faults; the in-process ladder is
+        always available as the floor."""
+        if namespace_labels is None and cluster is not None:
+            namespace_labels = cluster.namespace_labels
+        # The wire deadline must COVER the server-side solve budget: a solve
+        # legitimately using its full timeout_seconds (which would at worst
+        # return partial results with timed_out=True) must not be cut off
+        # client-side, poisoning the connection and feeding the breaker.
+        # request_timeout_seconds is the floor, for transport-level stalls.
+        wire_timeout = self.request_timeout_seconds
+        if options is not None and options.timeout_seconds:
+            wire_timeout = max(
+                wire_timeout, options.timeout_seconds + SOLVE_DEADLINE_GRACE_SECONDS
+            )
+        if self.breaker.allow():
+            try:
+                decoded = self.client.solve(
+                    node_pools,
+                    instance_types_by_pool,
+                    pods,
+                    state_node_views,
+                    daemonset_pods,
+                    options,
+                    force_oracle,
+                    namespace_labels,
+                    timeout=wire_timeout,
+                    # the FULL cluster slice (scheduled pods, node labels)
+                    # crosses the wire: the sidecar must count existing
+                    # anti-affinity/spread state exactly like in-process
+                    cluster=cluster,
+                )
+                self.breaker.record_success()
+                SIDECAR_REQUESTS.inc({"outcome": "success"})
+                self.last_used = "sidecar"
+                self.fallback_reason = None
+                return self._to_results(decoded, pods)
+            except Exception as e:
+                self.breaker.record_failure()
+                SIDECAR_REQUESTS.inc({"outcome": "failure"})
+                SOLVER_FALLBACK.inc({"reason": "sidecar_unavailable"})
+                self.fallback_reason = (
+                    f"sidecar solve failed ({type(e).__name__}: {e}); "
+                    "degrading to in-process solver"
+                )
+                self.log.warn(
+                    "sidecar solve failed; degrading to in-process solver",
+                    error=f"{type(e).__name__}: {e}",
+                    consecutive_failures=self.breaker.consecutive_failures,
+                    breaker=self.breaker.state,
+                )
+        else:
+            SOLVER_FALLBACK.inc({"reason": "circuit_open"})
+            self.fallback_reason = (
+                "sidecar circuit open; solving in-process during cooldown"
+            )
+        return self._solve_in_process(
+            node_pools,
+            instance_types_by_pool,
+            pods,
+            state_node_views,
+            daemonset_pods,
+            options,
+            cluster,
+            namespace_labels,
+            force_oracle,
+        )
+
+    def _solve_in_process(
+        self,
+        node_pools,
+        instance_types_by_pool,
+        pods,
+        state_node_views,
+        daemonset_pods,
+        options,
+        cluster,
+        namespace_labels,
+        force_oracle,
+    ) -> Results:
+        results, scheduler = solve_in_process(
+            node_pools,
+            instance_types_by_pool,
+            pods,
+            state_node_views,
+            daemonset_pods,
+            options,
+            cluster=cluster or ClusterSource(namespace_labels=namespace_labels or {}),
+            force_oracle=force_oracle,
+        )
+        self.last_used = "tpu" if scheduler.used_tpu else "oracle"
+        return results
+
+    @staticmethod
+    def _to_results(decoded: dict, pods: list[Pod]) -> Results:
+        """Expand the decoded wire response (service.decode_result) into
+        the Results shape the provisioning controller consumes."""
+        uid_to_pod = {p.uid: p for p in pods}
+        claims = [
+            RemoteNodeClaim(
+                nodepool_name=c["nodepool"],
+                node_claim=c["node_claim"],
+                requests=c["requests"],
+                pods=[uid_to_pod[u] for u in c["pod_uids"] if u in uid_to_pod],
+            )
+            for c in decoded["new_node_claims"]
+        ]
+        by_node: dict[str, RemoteExistingNode] = {}
+        for uid, node_name in decoded["existing_assignments"].items():
+            node = by_node.setdefault(node_name, RemoteExistingNode(node_name))
+            if uid in uid_to_pod:
+                node.pods.append(uid_to_pod[uid])
+        return Results(
+            new_node_claims=claims,
+            existing_nodes=list(by_node.values()),
+            pod_errors=dict(decoded["pod_errors"]),
+            timed_out=bool(decoded["timed_out"]),
+        )

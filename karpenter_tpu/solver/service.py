@@ -3,10 +3,10 @@ unix-domain socket (SURVEY.md §7 M5; the reference's north star is a
 Go control plane reaching a TPU solver through cgo->gRPC — this is that
 boundary with the same framing discipline, minus the Go toolchain).
 
-Wire protocol (language-neutral; the C++ client in native/solver_client.cc
+Wire protocol v2 (language-neutral; the C++ client in native/solver_client.cc
 speaks it too):
 
-    frame   := magic "KTPU" | u32 kind | u32 len | payload[len]
+    frame   := magic "KTPU" | u32 kind | u32 req_id | u32 len | payload[len]
     kind    := 1 SOLVE request   (payload = problem JSON; pods ride as
                                   per-CLASS specs + flat base64 columns,
                                   SURVEY §7 hard-part #5 — the per-pod
@@ -18,6 +18,14 @@ speaks it too):
                3 ERROR response  (payload = utf-8 message)
                4 PING / 5 PONG   (health)
     u32     := little-endian
+    req_id  := request/response correlation: a response echoes the request's
+               id. Responses are in-order per connection (the server is
+               synchronous per connection), so the id is a tripwire, not a
+               demultiplexer: a client that reads a response whose id is not
+               the one it sent knows the stream is poisoned (e.g. it timed
+               out mid-read earlier and a stale response is still in flight)
+               and MUST tear the connection down — never resynchronize
+               mid-stream.
 
 Live cluster state (StateNodeViews) crosses the wire too, so a sidecar
 solve of a NON-empty cluster — provisioning onto existing capacity,
@@ -27,7 +35,21 @@ consolidation simulation — matches the in-process result
 Timeout/cancellation follows provisioner.go:366-374: the request carries
 `timeout_seconds`; the server passes it into SchedulerOptions so a Solve
 that overruns returns partial results with timed_out=True instead of
-hanging the control plane.
+hanging the control plane. The CLIENT additionally enforces a hard
+per-request deadline on the socket itself — a sidecar that stops
+responding (hung solve, dead process, black-holed proxy) can never block
+a control-plane call past its deadline (docs/resilience.md).
+
+Fault envelope (tests/test_service_faults.py drives every branch):
+- frames above MAX_FRAME_LEN are refused with an ERROR frame, then the
+  connection closes (the stream past a refused header is untrusted);
+- malformed payloads (bad JSON, bad schema) answer ERROR and keep serving;
+- a bad magic closes only that connection — framing is lost, the stream
+  cannot be resynchronized;
+- the accept loop survives ANY exception escaping a connection handler
+  (logged through karpenter_tpu.logging, never fatal);
+- stop() drains: in-flight solves finish and flush their responses before
+  the listener is torn down.
 """
 
 from __future__ import annotations
@@ -35,34 +57,84 @@ from __future__ import annotations
 import base64
 import json
 import os
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
+from karpenter_tpu import logging as klog
 from karpenter_tpu.api import codec
-from karpenter_tpu.solver.hybrid import HybridScheduler
+from karpenter_tpu.solver.hybrid import solve_in_process
 from karpenter_tpu.solver.nodes import StateNodeView
 from karpenter_tpu.solver.oracle import SchedulerOptions
-from karpenter_tpu.solver.topology import Topology
+from karpenter_tpu.solver.topology import ClusterSource
 
 MAGIC = b"KTPU"
+HEADER_LEN = 16  # magic(4) + kind(4) + req_id(4) + len(4)
 KIND_SOLVE = 1
 KIND_RESULT = 2
 KIND_ERROR = 3
 KIND_PING = 4
 KIND_PONG = 5
 
+# Refuse frames above this size with an ERROR frame: a corrupted length
+# field must not make either side try to buffer gigabytes. 64 MiB clears
+# the largest measured problem payload by >100x (the pod payload is
+# O(classes) JSON + O(pods) binary).
+MAX_FRAME_LEN = 64 * 1024 * 1024
 
-def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
-    sock.sendall(MAGIC + struct.pack("<II", kind, len(payload)) + payload)
+# A peer that starts a frame must finish it within this window; stalling
+# mid-frame is a fault (truncating proxy, wedged client), not idleness.
+FRAME_STALL_SECONDS = 30.0
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+class SolverUnavailable(ConnectionError):
+    """The sidecar could not produce a response within the client's retry
+    and deadline budget. The control plane treats this as 'degrade to the
+    in-process solver', mirroring the reference's typed cloud-provider
+    errors (provisioner.go:366-374)."""
+
+
+class SolverError(RuntimeError):
+    """The sidecar answered a clean ERROR frame: the solve itself failed
+    server-side. Transport is healthy; retrying the same problem would
+    fail the same way."""
+
+
+class ProtocolError(ValueError):
+    """The peer violated the framing discipline (bad magic, oversized
+    frame, correlation-id mismatch). The connection is not recoverable.
+    `req_id` is the offending frame's correlation id when the header was
+    still readable (0 otherwise), so the server can address its final
+    ERROR frame before closing."""
+
+    def __init__(self, msg: str, req_id: int = 0):
+        super().__init__(msg)
+        self.req_id = req_id
+
+
+def _send_frame(
+    sock: socket.socket, kind: int, payload: bytes, req_id: int = 0
+) -> None:
+    sock.sendall(
+        MAGIC + struct.pack("<III", kind, req_id & 0xFFFFFFFF, len(payload)) + payload
+    )
+
+
+def _recv_exact_deadline(sock: socket.socket, n: int, deadline: float) -> bytes:
+    """_recv_exact under a hard wall-clock deadline: every recv() gets only
+    the remaining budget, so trickling bytes cannot stretch the total past
+    the deadline."""
     buf = b""
     while len(buf) < n:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise socket.timeout("deadline exceeded")
+        sock.settimeout(remaining)
         got = sock.recv(n - len(buf))
         if not got:
             raise ConnectionError("peer closed")
@@ -70,12 +142,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _recv_frame(sock: socket.socket) -> tuple[int, bytes]:
-    head = _recv_exact(sock, 12)
+def _recv_frame_deadline(sock: socket.socket, deadline: float) -> tuple[int, int, bytes]:
+    head = _recv_exact_deadline(sock, HEADER_LEN, deadline)
     if head[:4] != MAGIC:
-        raise ValueError(f"bad magic {head[:4]!r}")
-    kind, length = struct.unpack("<II", head[4:])
-    return kind, _recv_exact(sock, length)
+        raise ProtocolError(f"bad magic {head[:4]!r}")
+    kind, req_id, length = struct.unpack("<III", head[4:])
+    if length > MAX_FRAME_LEN:
+        raise ProtocolError(
+            f"frame of {length} bytes exceeds max {MAX_FRAME_LEN}", req_id=req_id
+        )
+    return kind, req_id, _recv_exact_deadline(sock, length, deadline)
 
 
 # ---------------------------------------------------------------------------
@@ -195,6 +271,47 @@ def _decode_views(data) -> Optional[list[StateNodeView]]:
     return out
 
 
+def _encode_cluster(cluster) -> Optional[dict]:
+    """The ClusterSource slice topology counting needs on the server side:
+    scheduled pods by namespace (existing anti-affinity / spread-count
+    state), node labels by name, and namespace labels. Without this a
+    sidecar solve of a cluster with RUNNING pods would see an empty world
+    and could co-locate against existing anti-affinity. O(bound pods)
+    JSON — the flat-column optimization covers only the pending payload."""
+    if cluster is None:
+        return None
+    return {
+        "namespace_labels": dict(cluster.namespace_labels),
+        "pods_by_namespace": {
+            ns: codec.to_jsonable([p for p in pods if p.node_name])
+            for ns, pods in cluster.pods_by_namespace.items()
+        },
+        "node_labels_by_name": {
+            name: dict(node.metadata.labels)
+            for name, node in cluster.nodes_by_name.items()
+        },
+    }
+
+
+def _decode_cluster(req: dict) -> ClusterSource:
+    from karpenter_tpu.api import objects as api
+
+    cl = req.get("cluster")
+    if not cl:
+        return ClusterSource(namespace_labels=req.get("namespace_labels") or {})
+    nodes_by_name = {
+        name: api.Node(metadata=api.ObjectMeta(name=name, labels=dict(labels)))
+        for name, labels in cl.get("node_labels_by_name", {}).items()
+    }
+    pods_by_ns = {
+        ns: codec.from_jsonable(v)
+        for ns, v in cl.get("pods_by_namespace", {}).items()
+    }
+    return ClusterSource(
+        pods_by_ns, nodes_by_name, cl.get("namespace_labels") or {}
+    )
+
+
 def encode_problem_request(
     node_pools,
     instance_types_by_pool,
@@ -204,9 +321,13 @@ def encode_problem_request(
     options: Optional[SchedulerOptions] = None,
     force_oracle: bool = False,
     namespace_labels: Optional[dict] = None,
+    cluster=None,
 ) -> bytes:
+    if namespace_labels is None and cluster is not None:
+        namespace_labels = cluster.namespace_labels
     req = {
         "namespace_labels": namespace_labels or {},
+        "cluster": _encode_cluster(cluster),
         "node_pools": codec.to_jsonable(node_pools),
         "instance_types_by_pool": {
             k: codec.to_jsonable(list(v)) for k, v in instance_types_by_pool.items()
@@ -216,10 +337,21 @@ def encode_problem_request(
             _encode_views(state_node_views) if state_node_views is not None else None
         ),
         "daemonset_pods": codec.to_jsonable(daemonset_pods or []),
+        # EVERY SchedulerOptions field crosses the wire: a sidecar solving
+        # with defaults while the control plane configured otherwise is a
+        # silent decision divergence (feature gates, routing thresholds)
         "options": {
             "ignore_preferences": bool(options and options.ignore_preferences),
             "min_values_best_effort": bool(options and options.min_values_best_effort),
+            "reserved_capacity_enabled": bool(
+                options and options.reserved_capacity_enabled
+            ),
+            "reserved_offering_strict": bool(
+                options and options.reserved_offering_strict
+            ),
             "timeout_seconds": options.timeout_seconds if options else None,
+            "claim_slot_div": options.claim_slot_div if options else None,
+            "tpu_min_pods": options.tpu_min_pods if options else None,
         },
         "force_oracle": force_oracle,
     }
@@ -234,13 +366,26 @@ def _decode_problem_request(payload: bytes):
     }
     pods = _decode_pods_flat(req["pods_flat"])
     views = _decode_views(req.get("state_node_views"))
-    namespace_labels = req.get("namespace_labels") or {}
+    source = _decode_cluster(req)
     daemons = codec.from_jsonable(req.get("daemonset_pods") or [])
     o = req.get("options") or {}
+    defaults = SchedulerOptions()
     options = SchedulerOptions(
         ignore_preferences=o.get("ignore_preferences", False),
         min_values_best_effort=o.get("min_values_best_effort", False),
+        reserved_capacity_enabled=o.get("reserved_capacity_enabled", False),
+        reserved_offering_strict=o.get("reserved_offering_strict", False),
         timeout_seconds=o.get("timeout_seconds"),
+        claim_slot_div=(
+            o["claim_slot_div"]
+            if o.get("claim_slot_div") is not None
+            else defaults.claim_slot_div
+        ),
+        tpu_min_pods=(
+            o["tpu_min_pods"]
+            if o.get("tpu_min_pods") is not None
+            else defaults.tpu_min_pods
+        ),
     )
     return (
         node_pools,
@@ -250,7 +395,7 @@ def _decode_problem_request(payload: bytes):
         daemons,
         options,
         req.get("force_oracle", False),
-        namespace_labels,
+        source,
     )
 
 
@@ -277,6 +422,11 @@ def _encode_result(results, used_tpu: bool, pods) -> bytes:
             "nodepool": c.nodepool_name,
             "instance_types": [it.name for it in c.instance_type_options],
             "requests": dict(c.requests),
+            # the launchable form: requirements, taints, labels — everything
+            # the control plane's CreateNodeClaims needs, so a REMOTE solve
+            # is actionable without re-deriving template state client-side
+            # (solver/hybrid.py ResilientSolver._to_results)
+            "node_claim": codec.to_jsonable(c.to_node_claim()),
         }
         for c in results.new_node_claims
     ]
@@ -295,6 +445,9 @@ def decode_result(resp: dict, pods) -> dict:
     """Expand the flat assignment array back into per-pod maps."""
     assign = _unb64(resp["assign"], np.int32)
     claims = [dict(c, pod_uids=[]) for c in resp["new_node_claims"]]
+    for c in claims:
+        if c.get("node_claim") is not None:
+            c["node_claim"] = codec.from_jsonable(c["node_claim"])
     existing = {}
     for i, p in enumerate(pods):
         a = int(assign[i])
@@ -316,32 +469,55 @@ def decode_result(resp: dict, pods) -> dict:
 
 
 class SolverServer:
-    """Serves SOLVE frames; one connection at a time (the control plane is a
-    singleton provisioner — matching the reference's concurrency model)."""
+    """Serves SOLVE frames, one handler thread per connection (the control
+    plane is a singleton provisioner, but a drained-and-replaced control
+    plane briefly overlaps its successor — two live connections must both
+    be served, not queued behind each other).
 
-    def __init__(self, socket_path: str):
+    Robustness contract (ISSUE: no solver-side fault may wedge the accept
+    loop): solve failures answer ERROR on the same correlation id; framing
+    violations close only the offending connection; anything unexpected is
+    logged and the loop keeps serving. stop() drains gracefully — the
+    listener closes first, in-flight handlers get `drain_seconds` to flush
+    their responses."""
+
+    def __init__(self, socket_path: str, drain_seconds: float = 30.0):
         self.socket_path = socket_path
+        self.drain_seconds = drain_seconds
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._conns: set[threading.Thread] = set()
+        self._conns_lock = threading.Lock()
         self.solves = 0
+        self.log = klog.root.named("solver.service")
 
     def start(self) -> None:
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
+        self._stop.clear()
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.bind(self.socket_path)
-        self._sock.listen(4)
+        self._sock.listen(8)
         self._sock.settimeout(0.2)
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
+        """Graceful drain: stop accepting, let in-flight handlers finish
+        (bounded by drain_seconds), then tear the socket down."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            self._thread = None
+        deadline = time.monotonic() + self.drain_seconds
+        with self._conns_lock:
+            pending = list(self._conns)
+        for t in pending:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
         if self._sock is not None:
             self._sock.close()
+            self._sock = None
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
 
@@ -353,26 +529,100 @@ class SolverServer:
                 continue
             except OSError:
                 return
+            t = threading.Thread(target=self._run_conn, args=(conn,), daemon=True)
+            with self._conns_lock:
+                self._conns.add(t)
+            t.start()
+
+    def _run_conn(self, conn: socket.socket) -> None:
+        try:
+            self._handle(conn)
+        except socket.timeout:
+            # a response send stalled past FRAME_STALL_SECONDS: the peer
+            # stopped reading — drop the connection, keep serving
+            self.log.warn("peer stopped reading mid-response, closing connection")
+        except ConnectionError:
+            pass  # peer went away; normal churn
+        except ProtocolError as e:
+            # framing is lost — the stream cannot be resynchronized; answer
+            # once (best effort, the header's req_id if it was readable)
+            # and close only this connection
+            self.log.warn("protocol violation, closing connection", error=str(e))
             try:
-                self._handle(conn)
-            except (ConnectionError, ValueError):
+                _send_frame(conn, KIND_ERROR, str(e).encode(), req_id=e.req_id)
+            except OSError:
                 pass
-            finally:
-                conn.close()
+        except Exception as e:  # the accept loop must survive ANYTHING
+            self.log.error(
+                "unexpected error in connection handler",
+                error=f"{type(e).__name__}: {e}",
+            )
+        finally:
+            conn.close()
+            with self._conns_lock:
+                self._conns.discard(threading.current_thread())
+
+    def _recv_frame_idle(self, conn: socket.socket) -> tuple[int, int, bytes]:
+        """Receive one frame, polling the stop flag only BETWEEN frames:
+        the idle wait covers the first byte alone, so a poll timeout can
+        never discard a partially-read header and desync the stream. Once
+        a frame starts, the peer gets FRAME_STALL_SECONDS of WALL CLOCK to
+        finish it (same _recv_exact_deadline discipline as the client — a
+        peer trickling one byte per poll interval must not hold the
+        handler thread forever); a mid-frame stall is a fault, not
+        idleness."""
+        while True:
+            if self._stop.is_set():
+                raise ConnectionError("server stopping")
+            conn.settimeout(0.2)
+            try:
+                first = conn.recv(1)
+                break
+            except socket.timeout:
+                continue
+        if not first:
+            raise ConnectionError("peer closed")
+        deadline = time.monotonic() + FRAME_STALL_SECONDS
+        head = first + _recv_exact_deadline(conn, HEADER_LEN - 1, deadline)
+        if head[:4] != MAGIC:
+            raise ProtocolError(f"bad magic {head[:4]!r}")
+        kind, req_id, length = struct.unpack("<III", head[4:])
+        if length > MAX_FRAME_LEN:
+            raise ProtocolError(
+                f"frame of {length} bytes exceeds max {MAX_FRAME_LEN}", req_id=req_id
+            )
+        return kind, req_id, _recv_exact_deadline(conn, length, deadline)
+
+    def _send_response(self, conn: socket.socket, kind: int, payload: bytes, req_id: int) -> None:
+        """A peer that stops READING must not wedge the handler either:
+        sendall under a socket timeout enforces a total wall-clock bound
+        across its internal retries (CPython tracks a deadline)."""
+        conn.settimeout(FRAME_STALL_SECONDS)
+        _send_frame(conn, kind, payload, req_id=req_id)
 
     def _handle(self, conn: socket.socket) -> None:
         while not self._stop.is_set():
-            kind, payload = _recv_frame(conn)
+            try:
+                kind, req_id, payload = self._recv_frame_idle(conn)
+            except socket.timeout as e:
+                raise ProtocolError(f"peer stalled mid-frame: {e}") from e
             if kind == KIND_PING:
-                _send_frame(conn, KIND_PONG, b"")
+                self._send_response(conn, KIND_PONG, b"", req_id)
                 continue
             if kind != KIND_SOLVE:
-                _send_frame(conn, KIND_ERROR, f"unknown kind {kind}".encode())
+                self._send_response(
+                    conn, KIND_ERROR, f"unknown kind {kind}".encode(), req_id
+                )
                 continue
             try:
-                _send_frame(conn, KIND_RESULT, self._solve(payload))
+                result = self._solve(payload)
             except Exception as e:  # error frames, never a dead socket
-                _send_frame(conn, KIND_ERROR, str(e).encode())
+                self.log.warn("solve failed, answering ERROR", error=str(e))
+                self._send_response(
+                    conn, KIND_ERROR, f"{type(e).__name__}: {e}".encode(), req_id
+                )
+                continue
+            self._send_response(conn, KIND_RESULT, result, req_id)
 
     def _solve(self, payload: bytes) -> bytes:
         (
@@ -383,27 +633,18 @@ class SolverServer:
             daemons,
             options,
             force_oracle,
-            namespace_labels,
+            source,
         ) = _decode_problem_request(payload)
-        from karpenter_tpu.solver.topology import ClusterSource
-
-        topology = Topology(
+        results, scheduler = solve_in_process(
             node_pools,
             its_by_pool,
             pods,
-            cluster=ClusterSource(namespace_labels=namespace_labels),
-            state_node_views=views,
-        )
-        scheduler = HybridScheduler(
-            node_pools,
-            its_by_pool,
-            topology,
             views,
             daemons,
             options,
+            cluster=source,
             force_oracle=force_oracle,
         )
-        results = scheduler.solve(pods)
         self.solves += 1
         return _encode_result(results, bool(scheduler.used_tpu), pods)
 
@@ -413,23 +654,147 @@ class SolverServer:
 
 
 class SolverClient:
-    def __init__(self, socket_path: str):
-        self.socket_path = socket_path
-        self._sock: Optional[socket.socket] = None
+    """The control plane's side of the boundary, hardened per the failure
+    ladder (docs/resilience.md):
 
-    def connect(self, timeout: float = 5.0) -> None:
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.settimeout(timeout)
-        self._sock.connect(self.socket_path)
+    - requests carry a fresh correlation id; a response bearing any other
+      id means the stream is poisoned — tear down, never resynchronize;
+    - every call runs under a hard deadline (`request_timeout` default):
+      connect, send, and every recv share one wall-clock budget, so a hung
+      sidecar can never block the control plane past its deadline;
+    - a timeout mid-read poisons the connection (the late response may
+      still arrive) — the socket is closed, the next call reconnects;
+    - transport failures (refused/reset/closed) reconnect with exponential
+      backoff + jitter up to `max_retries`, inside the same deadline. A
+      SOLVE is stateless server-side, so retrying a possibly-executed
+      request is safe.
+
+    Exhausting the budget raises SolverUnavailable; a clean server-side
+    ERROR frame raises SolverError. Callers (ResilientSolver) treat both
+    as 'degrade down the ladder'."""
+
+    def __init__(
+        self,
+        socket_path: str,
+        connect_timeout: float = 5.0,
+        request_timeout: float = 30.0,
+        max_retries: int = 2,
+        backoff_base: float = 0.05,
+        backoff_cap: float = 2.0,
+        rng: Optional[random.Random] = None,
+        sleep=time.sleep,
+    ):
+        self.socket_path = socket_path
+        self.connect_timeout = connect_timeout
+        self.request_timeout = request_timeout
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self._rng = rng or random.Random()
+        self._sleep = sleep
+        self._sock: Optional[socket.socket] = None
+        self._next_id = 0
+        # observability for the breaker layer / tests
+        self.reconnects = 0
+        self.poisoned = 0
+
+    # -- connection management --------------------------------------------
+
+    def connect(self, timeout: Optional[float] = None) -> None:
+        self.close()
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout if timeout is not None else self.connect_timeout)
+        sock.connect(self.socket_path)
+        self._sock = sock
 
     def close(self) -> None:
         if self._sock is not None:
             self._sock.close()
             self._sock = None
 
-    def ping(self) -> bool:
-        _send_frame(self._sock, KIND_PING, b"")
-        kind, _ = _recv_frame(self._sock)
+    def _poison(self) -> None:
+        """Drop a connection whose stream state is no longer trustworthy
+        (partial read, stale in-flight response, framing violation)."""
+        self.poisoned += 1
+        self.close()
+
+    def _ensure_connected(self, deadline: float) -> None:
+        if self._sock is not None:
+            return
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise socket.timeout("deadline exceeded before connect")
+        self.connect(timeout=min(self.connect_timeout, remaining))
+        self.reconnects += 1
+
+    def _backoff(self, attempt: int, deadline: float) -> None:
+        """Exponential backoff with full jitter, clamped to the remaining
+        deadline budget (AWS-style decorrelated retries would also do; full
+        jitter is the simplest schedule that avoids thundering herds)."""
+        delay = min(self.backoff_cap, self.backoff_base * (2**attempt))
+        delay = self._rng.uniform(0, delay)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise socket.timeout("deadline exceeded during backoff")
+        self._sleep(min(delay, remaining))
+
+    # -- request/response --------------------------------------------------
+
+    def _roundtrip(
+        self, kind: int, payload: bytes, timeout: Optional[float]
+    ) -> tuple[int, bytes]:
+        """One correlated request/response under a hard deadline, with
+        bounded reconnect-and-retry on transport failure."""
+        budget = self.request_timeout if timeout is None else timeout
+        deadline = time.monotonic() + budget
+        attempt = 0
+        while True:
+            try:
+                self._ensure_connected(deadline)
+                self._next_id = (self._next_id % 0xFFFFFFFF) + 1
+                req_id = self._next_id
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout("deadline exceeded before send")
+                self._sock.settimeout(remaining)
+                _send_frame(self._sock, kind, payload, req_id=req_id)
+                try:
+                    rkind, rid, resp = _recv_frame_deadline(self._sock, deadline)
+                except ProtocolError:
+                    self._poison()  # framing lost (corrupted stream)
+                    raise
+                if rid != req_id:
+                    self._poison()
+                    raise ProtocolError(
+                        f"correlation mismatch: sent {req_id}, got {rid} — "
+                        "stream poisoned, tearing down"
+                    )
+                return rkind, resp
+            except socket.timeout as e:
+                # a partial read after timeout leaves the response in
+                # flight: poison, never resynchronize mid-stream
+                self._poison()
+                raise SolverUnavailable(
+                    f"no response within {budget:.3f}s deadline: {e}"
+                ) from e
+            except (ConnectionError, OSError) as e:
+                if isinstance(e, (SolverUnavailable,)):
+                    raise
+                self._poison()
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise SolverUnavailable(
+                        f"sidecar unreachable after {attempt} attempts: {e}"
+                    ) from e
+                try:
+                    self._backoff(attempt - 1, deadline)
+                except socket.timeout:
+                    raise SolverUnavailable(
+                        f"deadline exhausted retrying: {e}"
+                    ) from e
+
+    def ping(self, timeout: Optional[float] = None) -> bool:
+        kind, _ = self._roundtrip(KIND_PING, b"", timeout)
         return kind == KIND_PONG
 
     def solve(
@@ -442,6 +807,8 @@ class SolverClient:
         options: Optional[SchedulerOptions] = None,
         force_oracle: bool = False,
         namespace_labels: Optional[dict] = None,
+        timeout: Optional[float] = None,
+        cluster=None,
     ) -> dict:
         payload = encode_problem_request(
             node_pools,
@@ -452,9 +819,9 @@ class SolverClient:
             options,
             force_oracle,
             namespace_labels,
+            cluster,
         )
-        _send_frame(self._sock, KIND_SOLVE, payload)
-        kind, resp = _recv_frame(self._sock)
+        kind, resp = self._roundtrip(KIND_SOLVE, payload, timeout)
         if kind == KIND_ERROR:
-            raise RuntimeError(resp.decode())
+            raise SolverError(resp.decode())
         return decode_result(json.loads(resp), pods)

@@ -17,6 +17,7 @@ from typing import Optional
 from karpenter_tpu.api import labels as well_known
 from karpenter_tpu.api.objects import (
     Budget,
+    Container,
     Disruption,
     LabelSelector,
     NodeAffinity,
@@ -65,8 +66,15 @@ def pod(
     topology_spread_constraints: Optional[list[TopologySpreadConstraint]] = None,
     tolerations: Optional[list[Toleration]] = None,
     creation_timestamp: float = 0.0,
+    init_containers: Optional[list[Container]] = None,
+    overhead: Optional[dict[str, str | int]] = None,
 ) -> Pod:
-    """test.Pod(test.PodOptions{...}) equivalent (reference pkg/test/pods.go)."""
+    """test.Pod(test.PodOptions{...}) equivalent (reference pkg/test/pods.go).
+
+    `requests` are the MAIN container's requests; when `init_containers`
+    or `overhead` are given, the pod's effective requests resolve via the
+    Ceiling rule at construction (reference test.UnschedulablePod with
+    InitContainers/Overhead options, suite_test.go:1515)."""
     meta = ObjectMeta(
         name=name or f"pod-{ObjectMeta().uid[:8]}",
         namespace=namespace,
@@ -88,9 +96,20 @@ def pod(
                 else []
             ),
         )
+    parsed_requests = res.parse_list(requests or {})
+    containers: list[Container] = []
+    if init_containers or overhead:
+        # route through the Ceiling path: the main requests become the
+        # single app container, Pod.__post_init__ resolves the effective
+        # pod-level requests
+        containers = [Container(requests=parsed_requests)] if parsed_requests else []
+        parsed_requests = {}
     return Pod(
         metadata=meta,
-        requests=res.parse_list(requests or {}),
+        requests=parsed_requests,
+        containers=containers,
+        init_containers=list(init_containers or []),
+        overhead=res.parse_list(overhead or {}),
         node_selector=dict(node_selector or {}),
         node_affinity=node_affinity,
         pod_affinity=list(pod_requirements or []),
@@ -99,6 +118,19 @@ def pod(
         pod_anti_affinity_preferred=list(pod_anti_preferences or []),
         tolerations=list(tolerations or []),
         topology_spread_constraints=list(topology_spread_constraints or []),
+    )
+
+
+def container(
+    requests: Optional[dict[str, str | int]] = None,
+    limits: Optional[dict[str, str | int]] = None,
+    restart_policy: Optional[str] = None,
+) -> Container:
+    """v1.Container fixture for init-container/sidecar binpacking tests."""
+    return Container(
+        requests=res.parse_list(requests or {}),
+        limits=res.parse_list(limits or {}),
+        restart_policy=restart_policy,
     )
 
 

@@ -83,6 +83,42 @@ def fits(candidate: Mapping[str, int], total: Mapping[str, int]) -> bool:
     return True
 
 
+def ceiling(
+    containers: Iterable = (),
+    init_containers: Iterable = (),
+    overhead: Mapping[str, int] | None = None,
+) -> ResourceList:
+    """Effective pod requests from container-level specs (reference
+    resources.go:113 Ceiling / KEP-753 sidecar semantics):
+
+    - init containers run sequentially: the pod must fit the LARGEST of
+      them, each stacked on the restartable (sidecar) init containers that
+      started before it and keep running;
+    - restartable init containers ("Always") are sidecars: their requests
+      ride alongside the main containers for the pod's whole life;
+    - the result is max(sum(main) + sum(sidecars), rolling init max),
+      plus pod overhead (pod.Spec.Overhead, RuntimeClass);
+    - a resource present only in a container's limits acts as its request
+      (resources.go:96 MergeResourceLimitsIntoRequests).
+    """
+    restartable_init: ResourceList = {}
+    init_peak: ResourceList = {}
+    for c in init_containers:
+        reqs = c.effective_requests()
+        if c.restart_policy == "Always":
+            restartable_init = merge(restartable_init, reqs)
+            stacked = dict(restartable_init)
+        else:
+            stacked = merge(reqs, restartable_init)
+        init_peak = max_resources(init_peak, stacked)
+    main = merge(*(c.effective_requests() for c in containers))
+    total = merge(main, restartable_init)
+    total = max_resources(total, init_peak)
+    if overhead:
+        total = merge(total, overhead)
+    return total
+
+
 def requests_for_pods(pods: Iterable["Pod"]) -> ResourceList:
     """Total requests of a set of pods plus a `pods` count resource
     (reference resources.go:30 RequestsForPods)."""

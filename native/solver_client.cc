@@ -1,10 +1,13 @@
 // Native solver-service client: the cgo-shim equivalent of the reference's
 // planned Go -> sidecar boundary (SURVEY.md §7 M5 / §2.8 item 4).
 //
-// Speaks the KTPU frame protocol of karpenter_tpu/solver/service.py over a
-// unix-domain socket:
-//   frame := "KTPU" | u32le kind | u32le len | payload[len]
+// Speaks the KTPU frame protocol v2 of karpenter_tpu/solver/service.py over
+// a unix-domain socket:
+//   frame := "KTPU" | u32le kind | u32le req_id | u32le len | payload[len]
 //   kinds: 1=SOLVE 2=RESULT 3=ERROR 4=PING 5=PONG
+// A response echoes the request's req_id; a mismatch means the stream is
+// poisoned (a previous caller abandoned a read mid-frame) and the only safe
+// recovery is to close the connection — never resynchronize mid-stream.
 //
 // Usage:
 //   solver_client <socket-path> ping
@@ -55,23 +58,32 @@ bool recv_all(int fd, void* data, size_t n) {
   return true;
 }
 
-bool send_frame(int fd, uint32_t kind, const std::string& payload) {
-  char head[12];
+// Refuse absurd frame lengths (mirrors service.py MAX_FRAME_LEN): a
+// corrupted header must not make the client buffer gigabytes.
+constexpr uint32_t kMaxFrameLen = 64u * 1024u * 1024u;
+
+bool send_frame(int fd, uint32_t kind, uint32_t req_id,
+                const std::string& payload) {
+  char head[16];
   std::memcpy(head, kMagic, 4);
-  uint32_t k = kind, len = static_cast<uint32_t>(payload.size());
+  uint32_t k = kind, r = req_id, len = static_cast<uint32_t>(payload.size());
   std::memcpy(head + 4, &k, 4);   // little-endian hosts only (x86/arm LE)
-  std::memcpy(head + 8, &len, 4);
+  std::memcpy(head + 8, &r, 4);
+  std::memcpy(head + 12, &len, 4);
   if (!send_all(fd, head, sizeof head)) return false;
   return payload.empty() || send_all(fd, payload.data(), payload.size());
 }
 
-bool recv_frame(int fd, uint32_t* kind, std::string* payload) {
-  char head[12];
+bool recv_frame(int fd, uint32_t* kind, uint32_t* req_id,
+                std::string* payload) {
+  char head[16];
   if (!recv_all(fd, head, sizeof head)) return false;
   if (std::memcmp(head, kMagic, 4) != 0) return false;
   uint32_t len;
   std::memcpy(kind, head + 4, 4);
-  std::memcpy(&len, head + 8, 4);
+  std::memcpy(req_id, head + 8, 4);
+  std::memcpy(&len, head + 12, 4);
+  if (len > kMaxFrameLen) return false;
   payload->resize(len);
   return len == 0 || recv_all(fd, payload->data(), len);
 }
@@ -90,11 +102,16 @@ int connect_unix(const char* path) {
 }
 
 // The embeddable API: returns 0 and fills *result on success; 1 on a
-// solver-side ERROR frame (message in *result); negative on transport error.
+// solver-side ERROR frame (message in *result); negative on transport or
+// protocol error (including a correlation mismatch — caller must close
+// the fd, the stream is poisoned).
 int solve_request(int fd, const std::string& problem_json, std::string* result) {
-  if (!send_frame(fd, kSolve, problem_json)) return -2;
-  uint32_t kind = 0;
-  if (!recv_frame(fd, &kind, result)) return -3;
+  static uint32_t next_id = 0;
+  uint32_t req_id = ++next_id;
+  if (!send_frame(fd, kSolve, req_id, problem_json)) return -2;
+  uint32_t kind = 0, rid = 0;
+  if (!recv_frame(fd, &kind, &rid, result)) return -3;
+  if (rid != req_id) return -5;  // poisoned stream: close, reconnect
   if (kind == kError) return 1;
   if (kind != kResult) return -4;
   return 0;
@@ -116,9 +133,9 @@ int main(int argc, char** argv) {
   int rc = 0;
   if (cmd == "ping") {
     std::string payload;
-    uint32_t kind = 0;
-    if (!send_frame(fd, kPing, "") || !recv_frame(fd, &kind, &payload) ||
-        kind != kPong) {
+    uint32_t kind = 0, rid = 0;
+    if (!send_frame(fd, kPing, 1, "") ||
+        !recv_frame(fd, &kind, &rid, &payload) || kind != kPong || rid != 1) {
       std::fprintf(stderr, "ping failed\n");
       rc = 1;
     } else {

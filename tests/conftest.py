@@ -43,3 +43,33 @@ def _clear_jax_caches_per_module():
         jax.clear_caches()
     except Exception:
         pass
+
+
+# Hard per-test timeout for suites that exercise sockets and faults
+# (tests/test_service_faults.py): a wedged recv() must FAIL the test, never
+# hang tier-1. SIGALRM interrupts blocking syscalls in the main thread —
+# where pytest runs test bodies — and the handler raises into the test.
+import signal  # noqa: E402
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("hard_timeout")
+    if marker is None or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+    seconds = float(marker.args[0]) if marker.args else 60.0
+
+    def _abort(signum, frame):
+        raise TimeoutError(
+            f"hard_timeout: test exceeded its {seconds:.0f}s budget "
+            "(wedged socket? missed deadline?)"
+        )
+
+    old = signal.signal(signal.SIGALRM, _abort)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)

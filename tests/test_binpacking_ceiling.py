@@ -1,0 +1,217 @@
+"""Effective pod requests at intake: the Ceiling rule (reference
+pkg/utils/resources/resources.go:113) and its binpacking consequences,
+ported from the reference provisioning suite's Binpacking context
+(suite_test.go:1515-1829) — init containers, restartable (sidecar) init
+containers, limits-as-requests, and pod overhead (VERDICT r5 missing #1).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api.objects import Container, Pod
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.solver import HybridScheduler, SchedulerOptions, Topology
+from karpenter_tpu.testing import fixtures
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.quantity import parse as q
+
+
+def c(requests=None, limits=None, restart_policy=None) -> Container:
+    return fixtures.container(requests, limits, restart_policy)
+
+
+# ---------------------------------------------------------------------------
+# the Ceiling rule itself (resources.go:113)
+
+
+def test_main_containers_sum():
+    got = res.ceiling([c({"cpu": "1"}), c({"cpu": "2", "memory": "1Gi"})])
+    assert got == {"cpu": q("3"), "memory": q("1Gi")}
+
+
+def test_init_containers_take_the_rolling_max():
+    """Init containers run sequentially: the pod needs the LARGEST of
+    them, not the sum (suite_test.go: 'should select a larger instance
+    if initContainer requires more resources')."""
+    got = res.ceiling(
+        [c({"cpu": "1"})],
+        [c({"cpu": "4"}), c({"cpu": "2"})],
+    )
+    assert got["cpu"] == q("4")
+
+
+def test_main_wins_when_bigger_than_init():
+    got = res.ceiling([c({"cpu": "3"})], [c({"cpu": "1"})])
+    assert got["cpu"] == q("3")
+
+
+def test_sidecar_rides_alongside_main_containers():
+    """A restartable init container (RestartPolicy=Always) is a sidecar:
+    its requests ADD to the main containers for the pod's whole life
+    (KEP-753 / resources.go:113 restartableInitContainerReqs)."""
+    got = res.ceiling(
+        [c({"cpu": "1"})],
+        [c({"cpu": "500m"}, restart_policy="Always")],
+    )
+    assert got["cpu"] == q("1500m")
+
+
+def test_later_init_stacks_on_earlier_sidecars():
+    """A non-restartable init container that starts AFTER a sidecar runs
+    concurrently with it: its requirement stacks on the sidecar's."""
+    got = res.ceiling(
+        [c({"cpu": "1"})],
+        [
+            c({"cpu": "500m"}, restart_policy="Always"),
+            c({"cpu": "2"}),  # runs while the sidecar holds 500m
+        ],
+    )
+    # max(main 1 + sidecar 0.5, init 2 + sidecar 0.5) = 2.5
+    assert got["cpu"] == q("2500m")
+
+
+def test_sidecars_accumulate():
+    got = res.ceiling(
+        [c({"cpu": "1"})],
+        [
+            c({"cpu": "250m"}, restart_policy="Always"),
+            c({"cpu": "250m"}, restart_policy="Always"),
+        ],
+    )
+    assert got["cpu"] == q("1500m")
+
+
+def test_limits_act_as_requests_when_requests_absent():
+    """resources.go:96 MergeResourceLimitsIntoRequests: a resource present
+    only in limits counts as its request."""
+    got = res.ceiling([c(limits={"cpu": "2"})], [c(limits={"cpu": "3"})])
+    assert got["cpu"] == q("3")
+    # an explicit request wins over the limit
+    got = res.ceiling([c({"cpu": "1"}, limits={"cpu": "2"})])
+    assert got["cpu"] == q("1")
+
+
+def test_overhead_added_on_top():
+    """pod.Spec.Overhead (RuntimeClass) is charged to the pod on top of
+    the container ceiling (suite_test.go: 'should take pod runtime class
+    overhead into account')."""
+    got = res.ceiling([c({"cpu": "1"})], overhead={"cpu": q("250m")})
+    assert got["cpu"] == q("1250m")
+
+
+def test_pod_resolves_effective_requests_at_intake():
+    """Pod.__post_init__ collapses container-level specs into `requests`
+    — every downstream consumer (solver encoding, binpacking, the wire)
+    sees only the resolved form."""
+    p = Pod(
+        containers=[Container(requests={"cpu": q("1")})],
+        init_containers=[Container(requests={"cpu": q("4")})],
+        overhead={"cpu": q("100m")},
+    )
+    assert p.requests["cpu"] == q("4100m")
+    # explicit requests are authoritative (codec round-trips, deep copies)
+    p2 = Pod(requests={"cpu": q("7")}, containers=[Container(requests={"cpu": q("1")})])
+    assert p2.requests["cpu"] == q("7")
+
+
+def test_containers_survive_the_codec():
+    from karpenter_tpu.api import codec
+
+    p = fixtures.pod(
+        name="x",
+        requests={"cpu": "1"},
+        init_containers=[c({"cpu": "4"}, restart_policy=None)],
+        overhead={"cpu": "100m"},
+    )
+    rt = codec.from_jsonable(codec.to_jsonable(p))
+    assert rt.requests == p.requests
+    assert rt.requests["cpu"] == q("4100m")
+
+
+# ---------------------------------------------------------------------------
+# binpacking through the scheduler (suite_test.go:1515-1829)
+
+
+def _solve(pods, sizes):
+    fixtures.reset_rng(3)
+    its = construct_instance_types(sizes=sizes)
+    pools = [fixtures.node_pool(name="default")]
+    topo = Topology(pools, {"default": its}, pods)
+    s = HybridScheduler(
+        pools, {"default": its}, topo, None, None, SchedulerOptions(),
+        force_oracle=True,
+    )
+    return s.solve(pods)
+
+
+def _min_cpu(claim) -> int:
+    return min(it.capacity[res.CPU] for it in claim.instance_type_options)
+
+
+def test_selects_larger_instance_for_hungry_init_container():
+    """suite_test.go: 'should select a larger instance if initContainer
+    requires more resources' — the main container alone fits a 2-cpu
+    node; the init container forces a 16-cpu one."""
+    p = fixtures.pod(
+        name="init-hungry",
+        requests={"cpu": "1"},
+        init_containers=[c({"cpu": "10"})],
+    )
+    r = _solve([p], sizes=[2, 16])
+    assert not r.pod_errors
+    (claim,) = [cl for cl in r.new_node_claims if cl.pods]
+    assert _min_cpu(claim) >= q("10")
+
+
+def test_unschedulable_when_init_container_exceeds_every_instance():
+    """suite_test.go: 'should not schedule if initContainer resources are
+    too large'."""
+    p = fixtures.pod(
+        name="init-huge",
+        requests={"cpu": "1"},
+        init_containers=[c({"cpu": "100"})],
+    )
+    r = _solve([p], sizes=[2, 8])
+    assert p.uid in r.pod_errors
+    assert not any(cl.pods for cl in r.new_node_claims)
+
+
+def test_schedules_with_no_requests_or_limits():
+    """suite_test.go: 'should be able to schedule pods if resource
+    requests and limits are not defined'."""
+    p = Pod(containers=[Container()], init_containers=[Container()])
+    p.metadata.name = "empty"
+    r = _solve([p], sizes=[2])
+    assert not r.pod_errors
+
+
+def test_overhead_packs_fewer_pods_per_node():
+    """Overhead is charged per pod: two 700m pods fit one 2-cpu node, but
+    with 500m overhead each they no longer share it."""
+    def mk(i, overhead):
+        return fixtures.pod(
+            name=f"p-{i}", requests={"cpu": "700m"}, overhead=overhead
+        )
+
+    r_plain = _solve([mk(0, None), mk(1, None)], sizes=[2])
+    assert not r_plain.pod_errors
+    assert len([cl for cl in r_plain.new_node_claims if cl.pods]) == 1
+
+    r_heavy = _solve(
+        [mk(0, {"cpu": "500m"}), mk(1, {"cpu": "500m"})], sizes=[2]
+    )
+    assert not r_heavy.pod_errors
+    assert len([cl for cl in r_heavy.new_node_claims if cl.pods]) == 2
+
+
+def test_sidecar_requests_count_toward_the_claim():
+    """Sidecar (restartable init) requests ride the claim's running total,
+    not just the transient init peak."""
+    p = fixtures.pod(
+        name="with-sidecar",
+        requests={"cpu": "1"},
+        init_containers=[c({"cpu": "1"}, restart_policy="Always")],
+    )
+    r = _solve([p], sizes=[4])
+    assert not r.pod_errors
+    (claim,) = [cl for cl in r.new_node_claims if cl.pods]
+    assert claim.requests[res.CPU] >= q("2")

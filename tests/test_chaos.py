@@ -13,8 +13,8 @@ from karpenter_tpu.controllers.operator import Operator
 from karpenter_tpu.testing import fixtures
 
 
-def steady_operator(n_pods: int = 10) -> Operator:
-    op = Operator(clock=FakeClock(), force_oracle=True)
+def steady_operator(n_pods: int = 10, solver=None) -> Operator:
+    op = Operator(clock=FakeClock(), force_oracle=True, solver=solver)
     op.raw_cloud.types = construct_instance_types(sizes=[2, 8, 32])
     op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
     fixtures.reset_rng(5)
@@ -198,3 +198,50 @@ def test_long_horizon_churn_with_all_disruption_methods_armed():
     for n in op.kube.list("Node"):
         assert n.metadata.labels.get("generation") == "two", n.name
         assert n.ready
+
+
+def test_steady_workload_converges_identically_through_sidecar():
+    """Satellite (ISSUE): the steady-workload chaos scenario with the
+    sidecar in the loop — every provisioning solve rides SolverClient over
+    the UDS boundary instead of solving in-process. Convergence must be
+    IDENTICAL: same per-tick node counts, same final pod partition. The
+    resilience layer must not alter any scheduling decision."""
+    import tempfile
+
+    from karpenter_tpu.solver import ResilientSolver
+    from karpenter_tpu.solver.service import SolverServer
+
+    def run(solver=None):
+        op = steady_operator(solver=solver)
+        counts = []
+        for _ in range(40):
+            op.step(2.0)
+            counts.append(len(op.kube.list("Node")))
+        # the final partition: which pod names share which node, node
+        # names erased (the claim-name sequence is process-global)
+        by_node: dict[str, set] = {}
+        for p in op.kube.list("Pod"):
+            by_node.setdefault(p.node_name, set()).add(p.name)
+        partition = sorted(tuple(sorted(s)) for s in by_node.values())
+        return counts, partition
+
+    counts_local, partition_local = run(solver=None)
+
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SolverServer(path)
+    srv.start()
+    try:
+        rs = ResilientSolver(path, request_timeout_seconds=120.0)
+        counts_remote, partition_remote = run(solver=rs)
+        assert srv.solves > 0, "the sidecar was never consulted"
+        assert rs.breaker.state == "closed"
+    finally:
+        srv.stop()
+
+    assert counts_remote == counts_local, (
+        f"sidecar run diverged: {counts_remote} != {counts_local}"
+    )
+    assert partition_remote == partition_local
+    # converged and stayed converged, like the in-process guard demands
+    tail = counts_remote[-10:]
+    assert len(set(tail)) == 1, f"node count oscillates: {counts_remote}"

@@ -149,12 +149,15 @@ def test_error_frame_on_garbage(server):
     sock.settimeout(5)
     sock.connect(server.socket_path)
     payload = b"this is not json"
-    sock.sendall(MAGIC + struct.pack("<II", KIND_SOLVE, len(payload)) + payload)
+    sock.sendall(
+        MAGIC + struct.pack("<III", KIND_SOLVE, 7, len(payload)) + payload
+    )
     head = b""
-    while len(head) < 12:
-        head += sock.recv(12 - len(head))
-    kind, length = struct.unpack("<II", head[4:])
+    while len(head) < 16:
+        head += sock.recv(16 - len(head))
+    kind, req_id, length = struct.unpack("<III", head[4:])
     assert kind == KIND_ERROR
+    assert req_id == 7  # the ERROR answers on the request's correlation id
     sock.close()
 
 
@@ -294,3 +297,96 @@ def test_namespace_labels_ride_the_wire(server):
         if cl.pods
     )
     assert remote_parts == local_parts
+
+
+def test_scheduler_options_round_trip_the_wire():
+    """Code-review regression: EVERY SchedulerOptions field must cross the
+    wire — a sidecar solving with default gates/thresholds while the
+    control plane configured otherwise is a silent decision divergence."""
+    from karpenter_tpu.solver.service import _decode_problem_request
+
+    pools, ibp, pods, _ = _problem(n=2, with_views=False)
+    sent = SchedulerOptions(
+        ignore_preferences=True,
+        min_values_best_effort=True,
+        reserved_capacity_enabled=True,
+        reserved_offering_strict=True,
+        timeout_seconds=7.5,
+        claim_slot_div=5,
+        tpu_min_pods=0,
+    )
+    payload = encode_problem_request(pools, ibp, pods, options=sent)
+    got = _decode_problem_request(payload)[5]
+    assert got == sent
+
+
+def test_existing_anti_affinity_state_rides_the_wire(server):
+    """Code-review regression: a sidecar solve must see the cluster's
+    RUNNING pods — a pending pod with required anti-affinity to a label
+    carried by a running pod must not be co-located onto that pod's node,
+    exactly like the in-process solve."""
+    from karpenter_tpu.api.objects import (
+        LabelSelector,
+        Node,
+        ObjectMeta,
+        PodAffinityTerm,
+    )
+    from karpenter_tpu.solver.topology import ClusterSource
+
+    def build():
+        fixtures.reset_rng(31)
+        its = construct_instance_types(sizes=[2, 8])
+        pools = [fixtures.node_pool(name="default")]
+        views = _views()  # roomy existing nodes the pod WOULD land on
+        anchor = fixtures.pod(
+            name="anchor", labels={"db": "primary"}, requests={"cpu": "100m"}
+        )
+        anchor.metadata.namespace = "default"
+        anchor.node_name = views[0].name
+        anchor.phase = "Running"
+        nodes_by_name = {
+            v.name: Node(metadata=ObjectMeta(name=v.name, labels=dict(v.labels)))
+            for v in views
+        }
+        source = ClusterSource(
+            pods_by_namespace={"default": [anchor]},
+            nodes_by_name=nodes_by_name,
+            namespace_labels={"default": {}},
+        )
+        pending = fixtures.pod(
+            name="avoider",
+            requests={"cpu": "100m"},
+            pod_anti_requirements=[
+                PodAffinityTerm(
+                    topology_key=well_known.HOSTNAME_LABEL_KEY,
+                    label_selector=LabelSelector(match_labels={"db": "primary"}),
+                )
+            ],
+        )
+        return pools, {"default": its}, [pending], views, source
+
+    # in-process: the anti-affinity keeps the pod off the anchor's node
+    pools, ibp, pods, views, source = build()
+    topo = Topology(pools, ibp, pods, cluster=source, state_node_views=views)
+    s = HybridScheduler(
+        pools, ibp, topo, views, None, SchedulerOptions(), force_oracle=True
+    )
+    r = s.solve(pods)
+    assert not r.pod_errors
+    local_nodes = {n.name for n in r.existing_nodes for _ in n.pods}
+    assert views[0].name not in local_nodes
+
+    # sidecar: same cluster slice crosses the wire, same refusal
+    pools, ibp, pods, views, source = build()
+    c = SolverClient(server.socket_path, request_timeout=120.0)
+    got = c.solve(
+        pools, ibp, pods, state_node_views=views, force_oracle=True, cluster=source
+    )
+    c.close()
+    assert not got["pod_errors"]
+    remote_nodes = set(got["existing_assignments"].values())
+    assert views[0].name not in remote_nodes, (
+        "sidecar co-located against existing anti-affinity: the cluster "
+        "slice was dropped on the wire"
+    )
+    assert remote_nodes == local_nodes

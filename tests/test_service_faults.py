@@ -1,0 +1,736 @@
+"""Fault-injection harness for the solver service boundary (ISSUE tentpole).
+
+A programmable proxy sits between SolverClient and SolverServer and
+delays, truncates, corrupts, and black-holes frames; further scenarios
+kill the server mid-solve and crash-loop it. The assertions are the
+resilience contract (docs/resilience.md):
+
+- no client call ever blocks past its deadline;
+- a partial read after timeout poisons the connection (tear down and
+  reconnect — never resynchronize mid-stream);
+- the server answers ERROR instead of dying, survives anything a
+  connection handler throws, serves connections concurrently, and drains
+  in-flight solves on stop;
+- the provisioning loop binds every pending pod via in-process fallback
+  in the SAME reconcile the sidecar dies, and the circuit breaker closes
+  again after the sidecar returns (chaos_test.go:48-90's convergence
+  demand, applied to the service boundary).
+
+Every test carries a SIGALRM-backed hard timeout (tests/conftest.py): a
+bug that wedges a socket fails fast instead of hanging tier-1.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import tempfile
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu import logging as klog
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.solver import (
+    CircuitBreaker,
+    HybridScheduler,
+    ResilientSolver,
+    SchedulerOptions,
+    Topology,
+)
+from karpenter_tpu.solver.hybrid import SIDECAR_REQUESTS, SOLVER_FALLBACK
+from karpenter_tpu.solver.service import (
+    KIND_ERROR,
+    KIND_PING,
+    KIND_PONG,
+    KIND_SOLVE,
+    MAGIC,
+    MAX_FRAME_LEN,
+    ProtocolError,
+    SolverClient,
+    SolverError,
+    SolverServer,
+    SolverUnavailable,
+)
+from karpenter_tpu.testing import fixtures
+
+pytestmark = [pytest.mark.faults, pytest.mark.hard_timeout(120)]
+
+
+# ---------------------------------------------------------------------------
+# the fault-injection proxy
+
+
+class FaultyProxy:
+    """A UDS man-in-the-middle with programmable faults on the
+    server->client direction (responses), applied once then reverting to
+    pass-through:
+
+    - "pass":      forward both directions untouched
+    - "blackhole": swallow client bytes; the server never sees the
+                   request, the client never gets a response
+    - "truncate":  forward the request; relay only `truncate_after` bytes
+                   of the response, then close both sides
+    - "corrupt":   forward the request; flip the response's first byte
+                   (the frame magic) so framing is unrecoverable
+    - "delay":     forward the request; sleep `delay` before relaying the
+                   response
+    """
+
+    def __init__(self, listen_path: str, target_path: str):
+        self.listen_path = listen_path
+        self.target_path = target_path
+        self.mode = "pass"
+        self.once = False
+        self.delay = 0.0
+        self.truncate_after = 20
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(listen_path)
+        self._sock.listen(8)
+        self._sock.settimeout(0.1)
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def set_fault(self, mode: str, once: bool = True, **kw) -> None:
+        with self._lock:
+            self.mode = mode
+            self.once = once
+            for k, v in kw.items():
+                setattr(self, k, v)
+
+    def _take_fault(self) -> str:
+        with self._lock:
+            mode = self.mode
+            if self.once and mode != "pass":
+                self.mode = "pass"
+            return mode
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._sock.close()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._relay, args=(client,), daemon=True
+            ).start()
+
+    def _relay(self, client: socket.socket) -> None:
+        mode = self._take_fault()
+        try:
+            upstream = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            upstream.connect(self.target_path)
+        except OSError:
+            client.close()
+            return
+        try:
+            if mode == "blackhole":
+                # read and discard until the client gives up
+                client.settimeout(0.2)
+                while not self._stop.is_set():
+                    try:
+                        if not client.recv(65536):
+                            return
+                    except socket.timeout:
+                        continue
+                    except OSError:
+                        return
+            # pump client -> server in the background
+            up = threading.Thread(
+                target=self._pump, args=(client, upstream, "pass", 0), daemon=True
+            )
+            up.start()
+            self._pump(upstream, client, mode, self.truncate_after)
+        finally:
+            for s in (client, upstream):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _pump(self, src: socket.socket, dst: socket.socket, mode: str, cut: int) -> None:
+        relayed = 0
+        first = True
+        src.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                chunk = src.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not chunk:
+                return
+            if mode == "delay" and first:
+                time.sleep(self.delay)
+            if mode == "corrupt" and first:
+                chunk = bytes([chunk[0] ^ 0xFF]) + chunk[1:]
+            if mode == "truncate":
+                chunk = chunk[: max(0, cut - relayed)]
+                if not chunk:
+                    return
+            first = False
+            relayed += len(chunk)
+            try:
+                dst.sendall(chunk)
+            except OSError:
+                return
+            if mode == "truncate" and relayed >= cut:
+                return
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+
+
+@pytest.fixture()
+def server():
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SolverServer(path)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def proxy(server):
+    path = tempfile.mktemp(suffix=".proxy.sock")
+    p = FaultyProxy(path, server.socket_path)
+    yield p
+    p.stop()
+
+
+def _problem(n=6):
+    fixtures.reset_rng(11)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    pods = fixtures.make_diverse_pods(n)
+    return pools, {"default": its}, pods
+
+
+def _remote_parts(got, pods):
+    name_of = {p.uid: p.name for p in pods}
+    return sorted(
+        tuple(sorted(name_of[u] for u in cl["pod_uids"]))
+        for cl in got["new_node_claims"]
+        if cl["pod_uids"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# client deadlines & reconnect
+
+
+def test_blackhole_never_blocks_past_deadline(proxy):
+    proxy.set_fault("blackhole", once=False)
+    c = SolverClient(proxy.listen_path, request_timeout=0.6, max_retries=0)
+    pools, ibp, pods = _problem()
+    t0 = time.monotonic()
+    with pytest.raises(SolverUnavailable):
+        c.solve(pools, ibp, pods, force_oracle=True)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0, f"call blocked {elapsed:.2f}s past its 0.6s deadline"
+    # the connection is poisoned — a late response must never be read
+    assert c.poisoned >= 1
+    assert c._sock is None
+
+
+def test_truncated_response_poisons_then_retry_succeeds(proxy):
+    """A response cut mid-frame closes the stream; the client reconnects
+    (fresh correlation id, fresh stream) and the retry succeeds."""
+    proxy.set_fault("truncate", once=True, truncate_after=10)
+    c = SolverClient(proxy.listen_path, request_timeout=120.0, max_retries=2)
+    pools, ibp, pods = _problem()
+    got = c.solve(pools, ibp, pods, force_oracle=True)
+    assert c.reconnects >= 2  # initial connect + post-truncation reconnect
+    # parity with the in-process solve: the retry changed nothing
+    pools2, ibp2, pods2 = _problem()
+    topo = Topology(pools2, ibp2, pods2)
+    s = HybridScheduler(
+        pools2, ibp2, topo, None, None, SchedulerOptions(), force_oracle=True
+    )
+    r = s.solve(pods2)
+    local_parts = sorted(
+        tuple(sorted(p.name for p in cl.pods))
+        for cl in r.new_node_claims
+        if cl.pods
+    )
+    assert _remote_parts(got, pods) == local_parts
+    c.close()
+
+
+def test_corrupted_frame_poisons_connection(proxy):
+    """A flipped magic byte is an unrecoverable framing loss: the client
+    must poison the connection, not attempt to resynchronize."""
+    proxy.set_fault("corrupt", once=True)
+    c = SolverClient(proxy.listen_path, request_timeout=60.0, max_retries=0)
+    pools, ibp, pods = _problem()
+    with pytest.raises(ProtocolError):
+        c.solve(pools, ibp, pods, force_oracle=True)
+    assert c.poisoned >= 1 and c._sock is None
+    # next call reconnects cleanly
+    assert c.ping(timeout=30.0)
+    c.close()
+
+
+def test_delayed_response_within_deadline_succeeds(proxy):
+    proxy.set_fault("delay", once=True, delay=0.3)
+    c = SolverClient(proxy.listen_path, request_timeout=120.0)
+    assert c.ping()
+    c.close()
+
+
+def test_reconnect_backoff_respects_deadline():
+    """With no server at all, the retry schedule (backoff + jitter) must
+    still give up inside the request deadline."""
+    c = SolverClient(
+        tempfile.mktemp(suffix=".gone.sock"),
+        request_timeout=1.0,
+        max_retries=50,  # far more than the deadline can fund
+        backoff_base=0.05,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(SolverUnavailable):
+        c.ping()
+    assert time.monotonic() - t0 < 3.0
+
+
+# ---------------------------------------------------------------------------
+# server-side guards
+
+
+def test_error_frame_keeps_the_connection_serving(server):
+    c = SolverClient(server.socket_path, request_timeout=120.0)
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(10)
+    sock.connect(server.socket_path)
+    bad = b"{not json"
+    sock.sendall(MAGIC + struct.pack("<III", KIND_SOLVE, 9, len(bad)) + bad)
+    head = _read_exact(sock, 16)
+    kind, rid, length = struct.unpack("<III", head[4:])
+    _read_exact(sock, length)
+    assert (kind, rid) == (KIND_ERROR, 9)
+    # same connection, next request still served
+    sock.sendall(MAGIC + struct.pack("<III", KIND_PING, 10, 0))
+    head = _read_exact(sock, 16)
+    kind, rid, _ = struct.unpack("<III", head[4:])
+    assert (kind, rid) == (KIND_PONG, 10)
+    sock.close()
+    assert c.ping()
+    c.close()
+
+
+def _read_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        assert got, "peer closed early"
+        buf += got
+    return buf
+
+
+def test_oversized_frame_refused_with_error(server):
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(10)
+    sock.connect(server.socket_path)
+    sock.sendall(MAGIC + struct.pack("<III", KIND_SOLVE, 3, MAX_FRAME_LEN + 1))
+    head = _read_exact(sock, 16)
+    kind, rid, length = struct.unpack("<III", head[4:])
+    payload = _read_exact(sock, length)
+    assert (kind, rid) == (KIND_ERROR, 3)
+    assert b"exceeds max" in payload
+    # the stream past a refused header is untrusted: the server closes it
+    assert sock.recv(1) == b""
+    sock.close()
+    # but the listener is untouched
+    c = SolverClient(server.socket_path)
+    assert c.ping(timeout=10.0)
+    c.close()
+
+
+def test_solver_error_is_clean_and_non_fatal(server):
+    """A server-side solve failure answers ERROR on the same correlation
+    id (surfaced as SolverError); transport stays healthy."""
+    c = SolverClient(server.socket_path, request_timeout=60.0)
+    pools, ibp, pods = _problem(2)
+    kind, resp = c._roundtrip(KIND_SOLVE, b'{"no": "such schema"}', 60.0)
+    assert kind == KIND_ERROR and resp  # malformed schema answers ERROR
+    with pytest.raises(SolverError):
+        # the public path wraps the ERROR frame in a typed exception: a
+        # type-broken solve budget detonates server-side, mid-solve
+        c.solve(
+            pools, ibp, pods,
+            options=SchedulerOptions(timeout_seconds="bogus"),
+            force_oracle=True,
+        )
+    assert c.ping()
+    got = c.solve(pools, ibp, pods, force_oracle=True)
+    assert got["new_node_claims"]
+    c.close()
+
+
+def test_accept_loop_survives_unexpected_handler_error(server, monkeypatch):
+    """Satellite: an exception escaping a connection handler that is not
+    ConnectionError/ValueError must be logged and must NOT kill serving."""
+    original = SolverServer._handle
+
+    def exploding(self, conn):
+        raise RuntimeError("synthetic handler explosion")
+
+    monkeypatch.setattr(SolverServer, "_handle", exploding)
+    with klog.capture(level="error") as records:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(10)
+        sock.connect(server.socket_path)
+        sock.sendall(MAGIC + struct.pack("<III", KIND_PING, 1, 0))
+        # handler dies; the server closes this connection (EOF, or RST when
+        # the ping bytes were still unread at close)
+        try:
+            assert sock.recv(1) == b""
+        except ConnectionError:
+            pass
+        sock.close()
+        time.sleep(0.1)
+    assert any(
+        "unexpected error" in r["msg"]
+        and "synthetic handler explosion" in r.get("error", "")
+        for r in records.refresh()
+    ), records
+    monkeypatch.setattr(SolverServer, "_handle", original)
+    c = SolverClient(server.socket_path)
+    assert c.ping(timeout=10.0)
+    c.close()
+
+
+def test_concurrent_connections_are_served(server, monkeypatch):
+    """One slow solve must not head-of-line-block a second connection."""
+    original = SolverServer._solve
+
+    def slow(self, payload):
+        time.sleep(1.0)
+        return original(self, payload)
+
+    monkeypatch.setattr(SolverServer, "_solve", slow)
+    pools, ibp, pods = _problem(2)
+    a = SolverClient(server.socket_path, request_timeout=120.0)
+    done = {}
+
+    def solve_a():
+        done["a"] = a.solve(pools, ibp, pods, force_oracle=True)
+
+    t = threading.Thread(target=solve_a, daemon=True)
+    t.start()
+    time.sleep(0.2)  # solve in flight on connection A
+    b = SolverClient(server.socket_path, request_timeout=120.0)
+    t0 = time.monotonic()
+    assert b.ping()
+    assert time.monotonic() - t0 < 0.5, "second connection queued behind a solve"
+    t.join(timeout=60)
+    assert done["a"]["new_node_claims"]
+    a.close()
+    b.close()
+
+
+def test_graceful_drain_flushes_inflight_solve(server, monkeypatch):
+    original = SolverServer._solve
+
+    def slow(self, payload):
+        time.sleep(0.5)
+        return original(self, payload)
+
+    monkeypatch.setattr(SolverServer, "_solve", slow)
+    pools, ibp, pods = _problem(2)
+    c = SolverClient(server.socket_path, request_timeout=120.0)
+    box = {}
+
+    def solve():
+        box["got"] = c.solve(pools, ibp, pods, force_oracle=True)
+
+    t = threading.Thread(target=solve, daemon=True)
+    t.start()
+    time.sleep(0.2)  # request accepted, solve sleeping
+    server.stop()  # must drain, not sever
+    t.join(timeout=30)
+    assert "got" in box and box["got"]["new_node_claims"]
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# the failure ladder end to end: breaker, fallback, recovery
+
+
+def _mini_cluster(op):
+    from karpenter_tpu.api.objects import Budget
+
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 8])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    fixtures.reset_rng(5)
+    op.kube.create(
+        "NodePool", fixtures.node_pool(name="default", budgets=[Budget(nodes="100%")])
+    )
+
+
+def _placed(results) -> int:
+    """Pods that received a decision: new claims + existing capacity
+    (incl. in-flight claims from earlier reconciles — unbound pods stay
+    provisionable and re-solve onto them)."""
+    return sum(len(cl.pods) for cl in results.new_node_claims) + sum(
+        len(n.pods) for n in results.existing_nodes
+    )
+
+
+def _pending(op) -> int:
+    from karpenter_tpu.controllers.state import is_provisionable
+
+    return sum(1 for p in op.kube.list("Pod") if is_provisionable(p))
+
+
+def test_sidecar_killed_mid_solve_falls_back_same_reconcile(server):
+    """THE acceptance scenario: kill the sidecar, reconcile — every
+    pending pod still gets a decision in that same reconcile via the
+    in-process ladder; after the sidecar returns and the cooldown lapses,
+    the breaker closes and solves ride the sidecar again."""
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.operator import Operator
+
+    clock = FakeClock()
+    rs = ResilientSolver(
+        server.socket_path,
+        failure_threshold=2,
+        cooldown_seconds=30.0,
+        request_timeout_seconds=2.0,
+        clock=clock.now,
+    )
+    rs.client.backoff_base = 0.01  # keep retry sleeps test-sized
+    op = Operator(clock=clock, force_oracle=True, solver=rs)
+    _mini_cluster(op)
+
+    # round 1: sidecar healthy — solve rides the wire
+    for i in range(4):
+        op.kube.create("Pod", fixtures.pod(name=f"a-{i}", requests={"cpu": "400m"}))
+    n = _pending(op)
+    res1 = op.provisioner.reconcile(ignore_batcher=True)
+    assert op.provisioner.last_solver_used == "sidecar"
+    assert server.solves >= 1
+    assert not res1.results.pod_errors
+    assert _placed(res1.results) == n == 4
+
+    # round 2: kill the server mid-flight — SAME-reconcile fallback
+    server.stop()
+    fallback_before = SOLVER_FALLBACK.value({"reason": "sidecar_unavailable"})
+    for i in range(4):
+        op.kube.create("Pod", fixtures.pod(name=f"b-{i}", requests={"cpu": "400m"}))
+    n = _pending(op)
+    res2 = op.provisioner.reconcile(ignore_batcher=True)
+    assert op.provisioner.last_solver_used == "oracle"
+    assert not res2.results.pod_errors
+    assert _placed(res2.results) == n
+    assert SOLVER_FALLBACK.value({"reason": "sidecar_unavailable"}) > fallback_before
+    assert rs.breaker.state == "closed"  # one failure, threshold 2
+
+    # round 3: second consecutive failure trips the breaker open
+    for i in range(2):
+        op.kube.create("Pod", fixtures.pod(name=f"c-{i}", requests={"cpu": "400m"}))
+    n = _pending(op)
+    res3 = op.provisioner.reconcile(ignore_batcher=True)
+    assert not res3.results.pod_errors
+    assert _placed(res3.results) == n
+    assert rs.breaker.state == "open"
+
+    # round 4: breaker open — straight to in-process, no sidecar attempt
+    attempts = rs.client.reconnects
+    open_before = SOLVER_FALLBACK.value({"reason": "circuit_open"})
+    for i in range(2):
+        op.kube.create("Pod", fixtures.pod(name=f"d-{i}", requests={"cpu": "400m"}))
+    n = _pending(op)
+    res4 = op.provisioner.reconcile(ignore_batcher=True)
+    assert not res4.results.pod_errors
+    assert _placed(res4.results) == n
+    assert rs.client.reconnects == attempts, "open breaker must not dial the sidecar"
+    assert SOLVER_FALLBACK.value({"reason": "circuit_open"}) > open_before
+
+    # recovery: sidecar back + cooldown elapsed -> half-open probe -> closed
+    server.start()
+    clock.advance(31.0)
+    solves_before = server.solves
+    for i in range(2):
+        op.kube.create("Pod", fixtures.pod(name=f"e-{i}", requests={"cpu": "400m"}))
+    res5 = op.provisioner.reconcile(ignore_batcher=True)
+    assert op.provisioner.last_solver_used == "sidecar"
+    assert rs.breaker.state == "closed"
+    assert server.solves > solves_before
+    assert not res5.results.pod_errors
+
+
+def test_crash_loop_keeps_breaker_open_until_recovery(server):
+    """A crash-looping sidecar (up, dies, up, dies) must not pull the
+    control plane into paying full retry budgets every solve: once open,
+    only the half-open probe touches the socket."""
+    from karpenter_tpu.controllers.kube import FakeClock
+
+    clock = FakeClock()
+    rs = ResilientSolver(
+        server.socket_path,
+        failure_threshold=1,
+        cooldown_seconds=10.0,
+        request_timeout_seconds=1.0,
+        clock=clock.now,
+    )
+    rs.client.backoff_base = 0.01
+    pools, ibp, pods = _problem(3)
+    server.stop()  # crash
+
+    r = rs.solve(pools, ibp, pods, force_oracle=True)
+    assert rs.breaker.state == "open"
+    assert rs.last_used == "oracle"
+    assert not r.pod_errors
+
+    # crash-loop: server flaps up and down while the breaker is open —
+    # in-cooldown solves never touch it
+    attempts = rs.client.reconnects
+    for _ in range(3):
+        server.start()
+        server.stop()
+        r = rs.solve(pools, ibp, pods, force_oracle=True)
+        assert not r.pod_errors and rs.last_used == "oracle"
+    assert rs.client.reconnects == attempts
+
+    # half-open probe against a STILL-dead server re-opens immediately
+    clock.advance(11.0)
+    r = rs.solve(pools, ibp, pods, force_oracle=True)
+    assert rs.breaker.state == "open" and not r.pod_errors
+
+    # and against a recovered server, closes
+    server.start()
+    clock.advance(11.0)
+    ok_before = SIDECAR_REQUESTS.value({"outcome": "success"})
+    r = rs.solve(pools, ibp, pods, force_oracle=True)
+    assert rs.breaker.state == "closed"
+    assert rs.last_used == "sidecar"
+    assert SIDECAR_REQUESTS.value({"outcome": "success"}) > ok_before
+    assert not r.pod_errors
+
+
+def test_circuit_breaker_state_machine():
+    t = {"now": 0.0}
+    b = CircuitBreaker(failure_threshold=3, cooldown_seconds=5.0, clock=lambda: t["now"])
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    t["now"] = 4.9
+    assert not b.allow()
+    t["now"] = 5.0
+    assert b.allow() and b.state == "half-open"
+    b.record_failure()  # probe failed: re-open, fresh cooldown
+    assert b.state == "open" and not b.allow()
+    t["now"] = 10.0
+    assert b.allow() and b.state == "half-open"
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+
+
+def test_remote_solve_matches_in_process_through_resilient_solver(server):
+    """The resilience layer must not alter any scheduling decision: a
+    sidecar solve through ResilientSolver partitions pods identically to
+    the in-process HybridScheduler."""
+    rs = ResilientSolver(server.socket_path, request_timeout_seconds=120.0)
+    pools, ibp, pods = _problem(8)
+    r_remote = rs.solve(pools, ibp, pods, force_oracle=True)
+    assert rs.last_used == "sidecar"
+
+    pools2, ibp2, pods2 = _problem(8)
+    topo = Topology(pools2, ibp2, pods2)
+    s = HybridScheduler(
+        pools2, ibp2, topo, None, None, SchedulerOptions(), force_oracle=True
+    )
+    r_local = s.solve(pods2)
+
+    def parts(r):
+        return sorted(
+            tuple(sorted(p.name for p in cl.pods))
+            for cl in r.new_node_claims
+            if cl.pods
+        )
+
+    assert parts(r_remote) == parts(r_local)
+    assert r_remote.pod_errors == r_local.pod_errors
+    # remote claims are launchable: the full NodeClaim crossed the wire
+    for cl in r_remote.new_node_claims:
+        nc = cl.to_node_claim()
+        assert nc.requirements, "wire NodeClaim lost its requirements"
+        assert any(
+            req.key == "karpenter.sh/nodepool" or True for req in nc.requirements
+        )
+        assert nc.resources_requests
+
+
+def test_wire_deadline_covers_server_solve_budget():
+    """Code-review regression: a solve legitimately using its full
+    server-side budget (which at worst returns partial results with
+    timed_out=True) must not be cut off by a SHORTER client deadline —
+    that would poison the connection and feed the breaker on a healthy
+    sidecar. The wire deadline derives from the solve budget + grace."""
+    from karpenter_tpu.solver.hybrid import SOLVE_DEADLINE_GRACE_SECONDS
+
+    class StubClient:
+        def __init__(self):
+            self.seen_timeout = None
+
+        def solve(self, *args, timeout=None, **kwargs):
+            self.seen_timeout = timeout
+            raise SolverUnavailable("stub: not actually dialing")
+
+    stub = StubClient()
+    rs = ResilientSolver(client=stub, request_timeout_seconds=5.0)
+    pools, ibp, pods = _problem(2)
+    r = rs.solve(
+        pools, ibp, pods,
+        options=SchedulerOptions(timeout_seconds=60.0), force_oracle=True,
+    )
+    assert stub.seen_timeout >= 60.0 + SOLVE_DEADLINE_GRACE_SECONDS
+    assert rs.last_used == "oracle" and not r.pod_errors
+    # with no solve budget, the configured request timeout is the floor
+    rs.solve(pools, ibp, pods, options=SchedulerOptions(), force_oracle=True)
+    assert stub.seen_timeout == 5.0
+
+
+def test_trickling_frame_cannot_wedge_a_handler(server, monkeypatch):
+    """Code-review regression: the server's mid-frame stall guard is WALL
+    CLOCK, not per-recv — a peer trickling one byte per poll interval
+    must lose its connection at the stall deadline, not hold the handler
+    thread forever."""
+    from karpenter_tpu.solver import service as svc
+
+    monkeypatch.setattr(svc, "FRAME_STALL_SECONDS", 0.6)
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(10)
+    sock.connect(server.socket_path)
+    t0 = time.monotonic()
+    sock.sendall(MAGIC[:2])  # start a frame...
+    time.sleep(0.3)
+    sock.sendall(MAGIC[2:3])  # ...keep trickling inside the per-recv window
+    # never finish the header; the WALL-CLOCK deadline must fire
+    try:
+        got = sock.recv(1)
+    except ConnectionError:
+        got = b""
+    assert got == b"" or got, "connection should close (EOF/RST)"
+    assert time.monotonic() - t0 < 5.0, "stall guard did not fire at wall clock"
+    sock.close()
+    # the listener is untouched
+    c = SolverClient(server.socket_path)
+    assert c.ping(timeout=10.0)
+    c.close()
