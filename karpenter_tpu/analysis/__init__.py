@@ -1,0 +1,45 @@
+"""graftlint — the AST-based invariant analyzer for this codebase.
+
+Mechanically enforces the architecture contracts documented in CLAUDE.md
+and the gate comments atop solver/tpu_runs.py: shared FFD comparator
+parity, kernel trace purity, int32-overflow guards in the consolidation
+sweep, integer milli-unit resources, lock discipline at the service
+boundary, `_ktpu_*` cache invalidation on relax mutations, reference
+citation hygiene, and pytest marker registration.
+
+Pure stdlib `ast` — importing this package MUST NOT import JAX or numpy
+(tests/test_static_analysis.py pins this), so the lint gate runs in
+seconds with no device/tunnel involvement.
+
+Usage:
+    python -m karpenter_tpu.analysis            # lint package + tests
+    python -m karpenter_tpu.analysis --json     # machine-readable
+    python -m karpenter_tpu.analysis --changed-only   # pre-commit mode
+
+Rules, suppression syntax (`# graftlint: disable=<rule>`) and the
+baseline workflow are documented in docs/static-analysis.md.
+"""
+
+from karpenter_tpu.analysis.engine import (
+    Baseline,
+    Config,
+    FileContext,
+    Finding,
+    Rule,
+    all_rules,
+    analyze_files,
+    discover_files,
+    run_analysis,
+)
+
+__all__ = [
+    "Baseline",
+    "Config",
+    "FileContext",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "analyze_files",
+    "discover_files",
+    "run_analysis",
+]

@@ -1,0 +1,216 @@
+"""graftlint CLI: `python -m karpenter_tpu.analysis` (also installed as
+the `graftlint` console script).
+
+Exit codes: 0 clean (baseline-covered findings allowed), 1 findings or
+stale/unjustified baseline entries, 2 usage or parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+from karpenter_tpu.analysis.engine import (
+    Baseline,
+    all_rules,
+    run_analysis,
+)
+
+
+def _detect_repo_root() -> str:
+    # the package lives at <root>/karpenter_tpu/analysis
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _changed_files(repo_root: str):
+    """Modified + untracked .py files (git), for pre-commit `--changed-only`.
+    Returns None when git itself fails — the caller must surface that as an
+    error, never as 'nothing to lint'."""
+    out: set[str] = set()
+    for args in (
+        ["git", "-C", repo_root, "diff", "--name-only", "HEAD"],
+        ["git", "-C", repo_root, "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                args, capture_output=True, text=True, timeout=10
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            print(f"graftlint: git failed ({e})", file=sys.stderr)
+            return None
+        if res.returncode != 0:
+            print(
+                f"graftlint: git failed: {res.stderr.strip()}", file=sys.stderr
+            )
+            return None
+        out.update(line.strip() for line in res.stdout.splitlines() if line.strip())
+    return sorted(
+        os.path.join(repo_root, p)
+        for p in out
+        if p.endswith(".py") and os.path.exists(os.path.join(repo_root, p))
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based invariant analyzer (docs/static-analysis.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", help="files/dirs to lint (default: package + tests)"
+    )
+    parser.add_argument("--root", default=None, help="repo root (default: auto)")
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: <root>/graftlint.baseline.json)",
+    )
+    parser.add_argument(
+        "--reference-root",
+        default="/root/reference",
+        help="reference checkout for .go citation resolution",
+    )
+    parser.add_argument(
+        "--rules", default=None, help="comma-separated rule ids to run"
+    )
+    parser.add_argument("--json", action="store_true", help="JSON output")
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="lint only git-modified/untracked files (pre-commit fast mode)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline file (justify each!)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rule ids and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id:20s} {r.summary}")
+        return 0
+
+    repo_root = os.path.abspath(args.root or _detect_repo_root())
+    paths = [os.path.abspath(p) for p in args.paths] or None
+    if args.changed_only:
+        paths = _changed_files(repo_root)
+        if paths is None:
+            return 2  # git failure must not read as a clean lint
+        if not paths:
+            print("graftlint: no changed python files")
+            return 0
+    rule_ids = (
+        {r.strip() for r in args.rules.split(",")} if args.rules else None
+    )
+    baseline_path = args.baseline or os.path.join(
+        repo_root, "graftlint.baseline.json"
+    )
+
+    report = run_analysis(
+        repo_root,
+        paths=paths,
+        baseline_path=baseline_path,
+        reference_root=args.reference_root,
+        rule_ids=rule_ids,
+    )
+
+    if args.write_baseline:
+        if paths is not None:
+            # a subset run sees only a slice of the findings; rewriting
+            # from it would truncate every out-of-scope curated entry
+            print(
+                "graftlint: --write-baseline requires a full-tree run "
+                "(no explicit paths / --changed-only)",
+                file=sys.stderr,
+            )
+            return 2
+        # regeneration must keep hand-written justifications: entries that
+        # still match a finding carry their text over, only genuinely new
+        # findings get the TODO placeholder
+        existing = Baseline.load(baseline_path)
+        keep: dict[tuple, list[str]] = {}
+        for e in existing.entries:
+            k = (e.get("rule"), e.get("path"), e.get("text"))
+            keep.setdefault(k, []).append(str(e.get("justification", "")))
+        data = Baseline.render_entries(report["all_findings"])
+        fresh = 0
+        for entry in data["entries"]:
+            k = (entry["rule"], entry["path"], entry["text"])
+            bucket = keep.get(k)
+            if bucket:
+                entry["justification"] = bucket.pop(0)
+            else:
+                fresh += 1
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")
+        print(
+            f"graftlint: wrote {len(data['entries'])} entr"
+            f"{'y' if len(data['entries']) == 1 else 'ies'} to "
+            f"{baseline_path}"
+            + (f" — justify the {fresh} new one(s)" if fresh else "")
+        )
+        return 0
+
+    findings = report["findings"]
+    # subset runs (--changed-only, explicit paths) leave baseline entries
+    # for out-of-scope files unmatched — that is expected, not staleness;
+    # only the default full-tree run polices baseline rot
+    stale = [] if paths is not None else report["stale"]
+    unjustified = report["unjustified"]
+    errors = report["errors"]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "stale_baseline": stale,
+                    "unjustified_baseline": unjustified,
+                    "errors": errors,
+                    "baselined": report["total"] - len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        for e in stale:
+            print(
+                f"stale baseline entry: [{e.get('rule')}] {e.get('path')}: "
+                f"{e.get('text')!r} no longer matches — remove it"
+            )
+        for e in unjustified:
+            print(
+                f"unjustified baseline entry: [{e.get('rule')}] "
+                f"{e.get('path')}: add a one-line justification"
+            )
+        for e in errors:
+            print(f"parse error: {e}")
+        baselined = report["total"] - len(findings)
+        print(
+            f"graftlint: {len(findings)} finding"
+            f"{'' if len(findings) == 1 else 's'}"
+            + (f", {baselined} baselined" if baselined else "")
+            + (f", {len(stale)} stale" if stale else "")
+        )
+
+    if findings or stale or unjustified:
+        return 1
+    if errors:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,204 @@
+"""Rules guarding the solver kernels' parity and trace discipline.
+
+- shared-comparator: CLAUDE.md "the oracle and the TPU path MUST sort
+  with the same key or parity breaks" — pod/solver ordering in the parity
+  modules has to flow through solver/ordering.py, never an inline key.
+- kernel-purity: host-sync constructs inside the jitted modules either
+  crash at trace time or silently fall off the device (a `.item()` in a
+  traced body blocks on the slow tunnel per CLAUDE.md's transfer note).
+- tracer-leak: a data-dependent Python `if`/`while` on a jnp value raises
+  ConcretizationTypeError at trace time — catch it at review time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from karpenter_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    Rule,
+    base_name,
+    ordering_import_names,
+)
+
+# modules whose decisions must stay bit-identical between oracle and kernel
+PARITY_MODULES = (
+    "karpenter_tpu/solver/oracle.py",
+    "karpenter_tpu/solver/tpu_runs.py",
+    "karpenter_tpu/solver/tpu.py",
+    "karpenter_tpu/controllers/disruption/sweep.py",
+)
+
+# modules whose function bodies are traced into XLA programs
+KERNEL_MODULES = (
+    "karpenter_tpu/solver/tpu_kernel.py",
+    "karpenter_tpu/solver/tpu_runs.py",
+    "karpenter_tpu/ops/kernels.py",
+)
+
+
+class SharedComparatorRule(Rule):
+    id = "shared-comparator"
+    summary = (
+        "sorts in parity modules must key through solver/ordering.py "
+        "(CLAUDE.md: oracle and TPU path must sort with the same key)"
+    )
+    targets = PARITY_MODULES
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        allowed = ordering_import_names(ctx.tree)
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_sorted = isinstance(node.func, ast.Name) and node.func.id == "sorted"
+            is_sort = (
+                isinstance(node.func, ast.Attribute) and node.func.attr == "sort"
+            )
+            if not (is_sorted or is_sort):
+                continue
+            key = next((k.value for k in node.keywords if k.arg == "key"), None)
+            if key is None:
+                continue  # keyless sorts order primitives, not pods
+            if self._key_uses_ordering(key, allowed):
+                continue
+            out.append(
+                ctx.finding(
+                    self.id,
+                    node,
+                    "inline sort key in a parity module; route the "
+                    "ordering through solver/ordering.py (ffd_sort_key / "
+                    "ffd_order_cols) or baseline with a justification",
+                )
+            )
+        return out
+
+    @staticmethod
+    def _key_uses_ordering(key: ast.AST, allowed: set[str]) -> bool:
+        if isinstance(key, ast.Name) and key.id in allowed:
+            return True
+        for sub in ast.walk(key):
+            if isinstance(sub, ast.Call):
+                root = base_name(sub.func)
+                fn = (
+                    sub.func.attr
+                    if isinstance(sub.func, ast.Attribute)
+                    else getattr(sub.func, "id", None)
+                )
+                if root in allowed or fn in allowed:
+                    return True
+        return False
+
+
+# host-sync calls that must never appear in a traced body
+_HOST_SYNC_ATTRS = frozenset({"item", "block_until_ready", "tolist"})
+_NUMPY_ALIASES = frozenset({"np", "numpy", "onp"})
+_NUMPY_SYNC_FNS = frozenset(
+    {"asarray", "array", "frombuffer", "concatenate", "stack", "copy"}
+)
+
+
+class KernelPurityRule(Rule):
+    id = "kernel-purity"
+    summary = (
+        "no host-sync constructs (print, .item(), numpy materialization, "
+        "float()/int() on traced values) inside kernel modules"
+    )
+    targets = KERNEL_MODULES
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = self._violation(node)
+            if msg:
+                out.append(ctx.finding(self.id, node, msg))
+        return out
+
+    @staticmethod
+    def _violation(node: ast.Call) -> str:
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id == "print":
+                return (
+                    "print() in a kernel module runs at trace time only "
+                    "(use jax.debug.print for traced values)"
+                )
+            if f.id in ("float", "int", "bool") and node.args:
+                arg = node.args[0]
+                if not isinstance(arg, ast.Constant) and "shape" not in ast.dump(
+                    arg
+                ):
+                    return (
+                        f"{f.id}() on a possibly-traced value forces a "
+                        "host sync; keep scalars on device (jnp casts) or "
+                        "derive from static shapes"
+                    )
+        if isinstance(f, ast.Attribute):
+            if f.attr in _HOST_SYNC_ATTRS:
+                return (
+                    f".{f.attr}() pulls a traced value to the host — a "
+                    "per-call tunnel round-trip (CLAUDE.md transfer note)"
+                )
+            root = base_name(f)
+            if root in _NUMPY_ALIASES and f.attr in _NUMPY_SYNC_FNS:
+                return (
+                    f"{root}.{f.attr}() materializes on the host inside a "
+                    "kernel module; use jnp equivalents"
+                )
+            if root == "jax" and f.attr == "device_get":
+                return "jax.device_get inside a kernel module is a host sync"
+        return ""
+
+
+_TRACED_ROOTS = frozenset({"jnp", "lax"})
+
+
+class TracerLeakRule(Rule):
+    id = "tracer-leak"
+    summary = (
+        "no data-dependent Python if/while on jnp values in kernel "
+        "modules (use lax.cond / lax.while_loop / jnp.where)"
+    )
+    targets = KERNEL_MODULES
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            leak = self._traced_expr(node.test)
+            if leak:
+                kw = "if" if isinstance(node, ast.If) else "while"
+                out.append(
+                    ctx.finding(
+                        self.id,
+                        node,
+                        f"`{kw}` branches on a traced value ({leak}); "
+                        "control flow on device values must use lax.cond/"
+                        "lax.while_loop or jnp.where",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _traced_expr(test: ast.AST) -> str:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                root = base_name(sub.func)
+                if root in _TRACED_ROOTS:
+                    fn = getattr(sub.func, "attr", root)
+                    return f"{root}.{fn}(...)"
+                if (
+                    root == "jax"
+                    and isinstance(sub.func, ast.Attribute)
+                    and isinstance(sub.func.value, ast.Attribute)
+                    and sub.func.value.attr in ("numpy", "lax")
+                ):
+                    return f"jax.{sub.func.value.attr}.{sub.func.attr}(...)"
+        return ""
+
+
+RULES = (SharedComparatorRule, KernelPurityRule, TracerLeakRule)

@@ -77,6 +77,7 @@ class SweepUnsupported(Exception):
 _fast_sweep_cached = None
 
 
+# graftlint: disable=dtype-overflow  (int64 worst-case guards live in the caller, _fast_prefix_feasibility; device math must stay int32)
 def _fast_sweep_kernel(tb, st, x, avail0, cand_idx, counts, sizes, singleton=False):
     """The delta-state consolidation sweep (module docstring §fast path).
 
@@ -413,10 +414,13 @@ def prefix_feasibility(
     VMAX = base.v_cnt.shape[1]
     Gh = base.h_cnt.shape[0]
     S = base.h_cnt.shape[1]
-    add_v = np.zeros((B, Gv, VMAX), np.int32)
-    rm_v = np.zeros((B, Gv, VMAX), np.int32)
-    add_h = np.zeros((B, Gh, S), np.int32)
-    rm_h = np.zeros((B, Gh, S), np.int32)
+    # deltas accumulate in int64; the guard below proves the restored
+    # counts fit int32 before they ride the device state (CLAUDE.md:
+    # int32 totals must never wrap)
+    add_v = np.zeros((B, Gv, VMAX), np.int64)
+    rm_v = np.zeros((B, Gv, VMAX), np.int64)
+    add_h = np.zeros((B, Gh, S), np.int64)
+    rm_h = np.zeros((B, Gh, S), np.int64)
     vocab = problem.vocab
     union_uids = {p.uid for p in pods}
     for j, c in enumerate(candidates):
@@ -502,6 +506,18 @@ def prefix_feasibility(
             + (tot_add_h[None] - cum_add_h)
             - cum_rm_h
         )
+
+    # int64 guard before the int32 device cast: a per-prefix count total
+    # that cannot ride the kernel's int32 topology state must fall back to
+    # the sequential scans, never wrap silently
+    peak = max(
+        int(np.abs(v_cnt_b).max(initial=0)),
+        int(np.abs(h_cnt_b).max(initial=0)),
+    )
+    if peak >= (1 << 31):
+        raise SweepUnsupported("per-prefix topology counts exceed int32")
+    v_cnt_b = v_cnt_b.astype(np.int32)
+    h_cnt_b = h_cnt_b.astype(np.int32)
 
     xs = sched._pod_xs(problem, order)
     P_pad = int(xs.valid.shape[0])

@@ -27,10 +27,12 @@ STATIC_NODES = metrics.REGISTRY.gauge(
 _static_seq = [0]
 
 
-def node_limit(np: NodePool) -> float:
-    """The pool's `nodes` limit as a node count (limits are stored in
-    milli-units — utils/resources.py); unlimited when absent."""
-    return float(np.limits.get("nodes", float("inf"))) / 1000.0
+def node_limit(np: NodePool) -> "float | int":
+    """The pool's `nodes` limit as a node count; unlimited when absent.
+    Limits are stored as integer milli-units (utils/resources.py: a limit
+    of "2" is 2000), so the count conversion stays integer."""
+    raw = np.limits.get("nodes")
+    return float("inf") if raw is None else raw // 1000
 
 
 def owned_claims(kube: SimKube, nodepool: str) -> list[NodeClaim]:

@@ -132,16 +132,25 @@ class Store:
     def __init__(self, gauge: Gauge):
         self.gauge = gauge
         self._owned: dict[str, list[dict]] = {}
+        # controllers updating the same store may run on worker pools;
+        # two racing update(key) calls must not interleave delete/set and
+        # leak orphaned series (lock order store -> gauge, never inverse)
+        self._lock = threading.Lock()
 
     def update(self, key: str, series: list[tuple[dict, float]]) -> None:
-        self.delete(key)
-        owned = []
-        for labels, value in series:
-            self.gauge.set(value, labels)
-            owned.append(labels)
-        self._owned[key] = owned
+        with self._lock:
+            self._delete_locked(key)
+            owned = []
+            for labels, value in series:
+                self.gauge.set(value, labels)
+                owned.append(labels)
+            self._owned[key] = owned
 
     def delete(self, key: str) -> None:
+        with self._lock:
+            self._delete_locked(key)
+
+    def _delete_locked(self, key: str) -> None:
         for labels in self._owned.pop(key, []):
             self.gauge.delete(labels)
 
@@ -149,6 +158,10 @@ class Store:
 class Registry:
     def __init__(self):
         self.metrics: dict[str, Metric] = {}
+        # registration mostly happens at import, but late registrations
+        # (test fixtures, lazily-built controllers) can race a /metrics
+        # scrape iterating the dict
+        self._lock = threading.Lock()
 
     def counter(self, name, help, label_names=()) -> Counter:
         return self._register(Counter(name, help, label_names))
@@ -160,16 +173,19 @@ class Registry:
         return self._register(Histogram(name, help, label_names, buckets))
 
     def _register(self, m):
-        existing = self.metrics.get(m.name)
-        if existing is not None:
-            return existing
-        self.metrics[m.name] = m
-        return m
+        with self._lock:
+            existing = self.metrics.get(m.name)
+            if existing is not None:
+                return existing
+            self.metrics[m.name] = m
+            return m
 
     def render(self) -> str:
         """Prometheus text exposition."""
         lines = []
-        for m in self.metrics.values():
+        with self._lock:
+            snapshot = list(self.metrics.values())
+        for m in snapshot:
             lines.append(f"# HELP {m.name} {m.help}")
             kind = (
                 "counter"
@@ -207,7 +223,8 @@ class Registry:
         return "\n".join(lines) + "\n"
 
     def reset(self):
-        self.metrics.clear()
+        with self._lock:
+            self.metrics.clear()
 
 
 REGISTRY = Registry()

@@ -489,6 +489,9 @@ class SolverServer:
         self._stop = threading.Event()
         self._conns: set[threading.Thread] = set()
         self._conns_lock = threading.Lock()
+        # handler threads (one per connection) all bump the solve counter;
+        # the read-modify-write needs its own lock or increments are lost
+        self._stats_lock = threading.Lock()
         self.solves = 0
         self.log = klog.root.named("solver.service")
 
@@ -645,7 +648,8 @@ class SolverServer:
             cluster=source,
             force_oracle=force_oracle,
         )
-        self.solves += 1
+        with self._stats_lock:
+            self.solves += 1
         return _encode_result(results, bool(scheduler.used_tpu), pods)
 
 

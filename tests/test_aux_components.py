@@ -827,7 +827,7 @@ def test_consistency_nodeshape_tolerance():
 
     assert claim.resources_requests.get(res.CPU)
     node = op.kube.get("Node", claim.status.node_name)
-    node.capacity[res.CPU] = int(claim.status.capacity[res.CPU] * 0.5)
+    node.capacity[res.CPU] = claim.status.capacity[res.CPU] // 2
     op.kube.update("Node", node)
     problems = op.consistency.reconcile_all()
     assert problems and "50.0% of expected" in problems[0]
@@ -836,7 +836,7 @@ def test_consistency_nodeshape_tolerance():
 
     # a small (<10%) shortfall is tolerated (nodeshape.go:51 pct < 0.90)
     node = op.kube.get("Node", claim.status.node_name)
-    node.capacity[res.CPU] = int(claim.status.capacity[res.CPU] * 0.95)
+    node.capacity[res.CPU] = claim.status.capacity[res.CPU] * 95 // 100
     op.kube.update("Node", node)
     assert op.consistency.reconcile_all() == []
 

@@ -1,0 +1,144 @@
+"""Property test for the shared-comparator invariant (CLAUDE.md: the
+oracle and the TPU path MUST sort with the same key or parity breaks).
+
+graftlint's shared-comparator rule enforces this statically (ordering may
+only flow through solver/ordering.py); this module checks the RUNTIME
+half independently: across seeded randomized pod sets, the oracle's sort
+(solver/oracle.py Queue, sorted by ffd_sort_key) and the TPU path's
+vectorized sort (solver/tpu.py:666 via ffd_order_cols) must produce the
+IDENTICAL permutation — not merely an equivalent packing.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from karpenter_tpu.api.objects import Toleration
+from karpenter_tpu.solver.oracle import Queue
+from karpenter_tpu.solver.nodes import PodData
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.solver.ordering import (
+    ffd_order,
+    ffd_order_cols,
+    ffd_sort_key,
+    pod_class_signature,
+)
+from karpenter_tpu.testing import fixtures
+from karpenter_tpu.utils import resources as res
+
+
+def _random_pods(rng: random.Random, n: int, ts_mode: str) -> list:
+    """Pods engineered to stress every tie-break level: a small discrete
+    CPU/memory grid forces request ties, a few scheduling-class variants
+    force class-signature grouping, and timestamps either fit float64 or
+    (ts_mode="wide") exceed 2^53 to force ffd_order_cols' exact-sort
+    fallback (nanosecond epochs don't round-trip through float64)."""
+    pods = []
+    for i in range(n):
+        cpu = rng.choice(["100m", "250m", "1", "2"])
+        mem = rng.choice(["128Mi", "1Gi"])
+        variant = rng.randrange(3)
+        selector = {"disktype": "ssd"} if variant == 1 else None
+        tols = (
+            [Toleration(key="dedicated", operator="Exists")]
+            if variant == 2
+            else None
+        )
+        if ts_mode == "wide":
+            # > 2^53: adjacent ints collapse in float64, so the lexsort
+            # column would be lossy — the comparator must detect it
+            ts = (1 << 53) + rng.randrange(0, 64)
+        else:
+            ts = rng.randrange(0, 1000)
+        p = fixtures.pod(
+            name=f"p-{i}",
+            requests={"cpu": cpu, "memory": mem},
+            node_selector=selector,
+            tolerations=tols,
+            creation_timestamp=ts,
+        )
+        p.metadata.uid = f"uid-{rng.randrange(10**9):09d}-{i}"
+        pods.append(p)
+    return pods
+
+
+def _requests_of(pod):
+    return res.requests_for_pods([pod])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7, 42, 1337])
+@pytest.mark.parametrize("ts_mode", ["narrow", "wide"])
+def test_oracle_and_tpu_orderings_identical(seed, ts_mode):
+    rng = random.Random(seed)
+    pods = _random_pods(rng, 200, ts_mode)
+
+    # oracle side: solver/oracle.py Queue sorts by ffd_sort_key
+    oracle_order = sorted(
+        range(len(pods)),
+        key=lambda i: ffd_sort_key(pods[i], _requests_of(pods[i])),
+    )
+
+    # TPU side: solver/tpu.py:666 builds columns and calls ffd_order_cols;
+    # ffd_order gathers the same columns from pod objects
+    tpu_order = ffd_order(pods, _requests_of)
+
+    assert tpu_order == oracle_order
+
+
+@pytest.mark.parametrize("seed", [3, 99])
+def test_queue_pops_in_comparator_order(seed):
+    """The oracle's actual Queue (scheduler entry) agrees with the raw
+    comparator — no hidden re-keying between ffd_sort_key and the solve
+    loop (queue.go:72-108)."""
+    rng = random.Random(seed)
+    pods = _random_pods(rng, 64, "narrow")
+    data = {
+        p.uid: PodData(
+            requests=_requests_of(p),
+            requirements=Requirements(),
+            strict_requirements=Requirements(),
+        )
+        for p in pods
+    }
+    q = Queue(list(pods), data)
+    popped = []
+    while True:
+        p = q.pop()
+        if p is None:
+            break
+        popped.append(p.uid)
+    expected = [
+        p.uid
+        for p in sorted(pods, key=lambda p: ffd_sort_key(p, _requests_of(p)))
+    ]
+    assert popped == expected
+
+
+def test_wide_timestamps_hit_exact_fallback():
+    """ffd_order_cols must not silently lexsort a lossy float64 timestamp
+    column: two pods whose nanosecond timestamps differ by 1 ULP-sub-f64
+    must still order by the exact integer (solver/ordering.py:228-239)."""
+    rng = random.Random(5)
+    pods = _random_pods(rng, 2, "narrow")
+    for p in pods:
+        p.requests = res.parse_list({"cpu": "1", "memory": "1Gi"})
+        p.node_selector = {}
+        p.tolerations = []
+        for attr in ("_ktpu_class_key", "_ktpu_class_repr", "_ktpu_class_sig"):
+            if hasattr(p, attr):
+                delattr(p, attr)
+    base = 1 << 54  # adjacent ints are NOT representable in float64
+    pods[0].metadata.creation_timestamp = base + 1
+    pods[1].metadata.creation_timestamp = base
+    sig = [pod_class_signature(p) for p in pods]
+    reqs = [_requests_of(p) for p in pods]
+    order = ffd_order_cols(
+        [r[res.CPU] for r in reqs],
+        [r[res.MEMORY] for r in reqs],
+        sig,
+        [p.metadata.creation_timestamp for p in pods],
+        [p.uid for p in pods],
+    )
+    assert order == [1, 0]  # exact integer order, not float64-collapsed

@@ -1,0 +1,655 @@
+"""graftlint gate: fixture-driven positive/negative cases per rule, the
+suppression/baseline mechanics, and the real-tree run.
+
+This module (and the analyzer itself) must work without importing JAX —
+pure stdlib `ast` — so the gate costs seconds, not a device warmup
+(docs/static-analysis.md). The subprocess test below pins the no-JAX
+property where conftest's eager jax import can't mask it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from karpenter_tpu.analysis import (
+    Baseline,
+    Config,
+    FileContext,
+    all_rules,
+    run_analysis,
+)
+from karpenter_tpu.analysis.__main__ import main as graftlint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_rule(rule_id, source, relpath, config=None):
+    """Run one rule over inline source posing as `relpath`."""
+    rule = next(r for r in all_rules() if r.id == rule_id)
+    assert rule.applies_to(relpath), f"{rule_id} must target {relpath}"
+    cfg = config or Config(repo_root=REPO_ROOT)
+    ctx = FileContext(relpath, relpath, textwrap.dedent(source), cfg)
+    return rule.run(ctx)
+
+
+# ---------------------------------------------------------------------------
+# shared-comparator
+
+
+def test_shared_comparator_flags_inline_key():
+    findings = run_rule(
+        "shared-comparator",
+        """
+        def order(pods):
+            return sorted(pods, key=lambda p: (p.cpu, p.mem))
+        """,
+        "karpenter_tpu/solver/oracle.py",
+    )
+    assert [f.rule for f in findings] == ["shared-comparator"]
+
+
+def test_shared_comparator_flags_method_sort():
+    findings = run_rule(
+        "shared-comparator",
+        """
+        def order(pods):
+            pods.sort(key=lambda p: p.uid)
+        """,
+        "karpenter_tpu/solver/tpu_runs.py",
+    )
+    assert len(findings) == 1
+
+
+def test_shared_comparator_allows_ordering_module_key():
+    findings = run_rule(
+        "shared-comparator",
+        """
+        from karpenter_tpu.solver.ordering import ffd_sort_key
+
+        def order(pods, data):
+            keyless = sorted([3, 1, 2])
+            return sorted(pods, key=lambda p: ffd_sort_key(p, data[p.uid]))
+        """,
+        "karpenter_tpu/solver/oracle.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# kernel-purity
+
+
+def test_kernel_purity_flags_host_sync():
+    findings = run_rule(
+        "kernel-purity",
+        """
+        import numpy as np
+
+        def _step(x):
+            print("debug", x)
+            y = float(x[0])
+            z = x.item()
+            return np.asarray(x) + y + z
+        """,
+        "karpenter_tpu/solver/tpu_kernel.py",
+    )
+    assert len(findings) == 4
+
+
+def test_kernel_purity_allows_traced_code():
+    findings = run_rule(
+        "kernel-purity",
+        """
+        import jax.numpy as jnp
+
+        def _step(x):
+            n = int(x.shape[0])
+            return jnp.where(x > 0, x, jnp.int32(0)).astype(jnp.float32), n
+        """,
+        "karpenter_tpu/solver/tpu_kernel.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# tracer-leak
+
+
+def test_tracer_leak_flags_python_branch_on_jnp():
+    findings = run_rule(
+        "tracer-leak",
+        """
+        import jax.numpy as jnp
+
+        def _step(mask, x):
+            if jnp.any(mask):
+                return x + 1
+            while jnp.sum(x) > 0:
+                x = x - 1
+            return x
+        """,
+        "karpenter_tpu/solver/tpu_runs.py",
+    )
+    assert len(findings) == 2
+
+
+def test_tracer_leak_allows_static_and_lax():
+    findings = run_rule(
+        "tracer-leak",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def _step(x, E):
+            if E > 0:  # static shape, fine
+                x = x + 1
+            return jax.lax.cond(x.sum() > 0, lambda: x, lambda: -x)
+        """,
+        "karpenter_tpu/solver/tpu_runs.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# dtype-overflow
+
+
+def test_dtype_overflow_flags_unguarded_accumulation():
+    findings = run_rule(
+        "dtype-overflow",
+        """
+        import numpy as np
+
+        def feasibility(counts, sizes):
+            caps = counts.astype(np.int32)
+            return np.cumsum(caps, axis=0)
+        """,
+        "karpenter_tpu/controllers/disruption/sweep.py",
+    )
+    assert len(findings) == 1
+
+
+def test_dtype_overflow_allows_guarded_accumulation():
+    findings = run_rule(
+        "dtype-overflow",
+        """
+        import numpy as np
+
+        def feasibility(counts, sizes):
+            worst = counts.astype(np.int64).sum()
+            if worst >= (1 << 31):
+                raise ValueError("would wrap int32")
+            caps = counts.astype(np.int32)
+            return np.cumsum(caps, axis=0)
+        """,
+        "karpenter_tpu/controllers/disruption/sweep.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# milli-units
+
+
+def test_milli_units_flags_division_and_float_literals():
+    findings = run_rule(
+        "milli-units",
+        """
+        def shave(requests):
+            half = requests["cpu"] / 2
+            padded = 1.5 * requests["memory"]
+            return half, padded
+        """,
+        "karpenter_tpu/controllers/provisioning.py",
+    )
+    assert len(findings) == 2
+
+
+def test_milli_units_covers_top_level_files_and_zero_literal():
+    """`dir/**/*.py` targets must also match direct children (fnmatch has
+    no recursive **), and 0.0 is a real float literal, not a falsy miss."""
+    findings = run_rule(
+        "milli-units",
+        """
+        def zero(requests):
+            return 0.0 * requests["cpu"]
+        """,
+        "tests/test_x.py",  # top level of tests/, no subdirectory
+    )
+    assert len(findings) == 1
+
+
+def test_milli_units_allows_integer_math_and_unrelated_floats():
+    findings = run_rule(
+        "milli-units",
+        """
+        def shave(requests, t0, t1):
+            half = requests["cpu"] // 2
+            speedup = t1 / t0  # seconds, not resources
+            return half, speedup
+        """,
+        "karpenter_tpu/controllers/provisioning.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+
+
+def test_lock_discipline_flags_unguarded_write_and_augassign():
+    findings = run_rule(
+        "lock-discipline",
+        """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self.state = "idle"
+
+            def guarded(self):
+                with self._lock:
+                    self.state = "busy"
+
+            def bypass(self):
+                self.state = "idle"  # guarded elsewhere, bare here
+
+            def bump(self):
+                self.count += 1  # read-modify-write, no lock
+        """,
+        "karpenter_tpu/solver/service.py",
+    )
+    assert len(findings) == 2
+    assert {"state" in f.message or "count" in f.message for f in findings} == {True}
+
+
+def test_lock_discipline_allows_guarded_and_locked_suffix():
+    findings = run_rule(
+        "lock-discipline",
+        """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+
+            def drain(self):
+                with self._lock:
+                    self._drain_locked()
+
+            def _drain_locked(self):
+                self.count = 0
+        """,
+        "karpenter_tpu/solver/service.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# cache-invalidation
+
+
+def test_cache_invalidation_flags_bare_mutation():
+    findings = run_rule(
+        "cache-invalidation",
+        """
+        def strip_tolerations(pod):
+            pod.tolerations = []
+            pod.topology_spread_constraints.pop()
+        """,
+        "karpenter_tpu/solver/tpu_problem.py",
+    )
+    assert len(findings) == 2
+
+
+def test_cache_invalidation_allows_invalidating_scope():
+    findings = run_rule(
+        "cache-invalidation",
+        """
+        class Preferences:
+            def relax(self, pod):
+                pod.tolerations = []
+                self._invalidate_class_caches(pod)
+
+            @staticmethod
+            def _invalidate_class_caches(pod):
+                for attr in ("_ktpu_class_key", "_ktpu_class_repr"):
+                    if hasattr(pod, attr):
+                        delattr(pod, attr)
+        """,
+        "karpenter_tpu/solver/oracle.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# citation-check
+
+
+@pytest.fixture
+def citation_config(tmp_path):
+    repo = tmp_path / "repo"
+    ref = tmp_path / "reference"
+    (repo / "karpenter_tpu" / "solver").mkdir(parents=True)
+    (repo / "karpenter_tpu" / "solver" / "ordering.py").write_text(
+        "\n".join(f"# line {i}" for i in range(1, 51)) + "\n"
+    )
+    (ref / "pkg" / "scheduling").mkdir(parents=True)
+    (ref / "pkg" / "scheduling" / "scheduler.go").write_text(
+        "\n".join(f"// line {i}" for i in range(1, 201)) + "\n"
+    )
+    return Config(repo_root=str(repo), reference_root=str(ref))
+
+
+def test_citation_check_flags_unresolvable_and_out_of_bounds(citation_config):
+    findings = run_rule(
+        "citation-check",
+        '''
+        def f():
+            """Mirrors nosuchfile.go:12 and scheduler.go:999 exactly."""
+        ''',
+        "karpenter_tpu/solver/x.py",
+        config=citation_config,
+    )
+    msgs = " ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "nosuchfile.go:12" in msgs and "scheduler.go:999" in msgs
+
+
+def test_citation_check_allows_resolvable_citations(citation_config):
+    findings = run_rule(
+        "citation-check",
+        '''
+        def f():
+            """Mirrors scheduler.go:100-150 via solver/ordering.py:10."""
+        ''',
+        "karpenter_tpu/solver/x.py",
+        config=citation_config,
+    )
+    assert findings == []
+
+
+def test_citation_check_skips_go_without_reference_tree(tmp_path):
+    cfg = Config(
+        repo_root=str(tmp_path), reference_root=str(tmp_path / "missing")
+    )
+    findings = run_rule(
+        "citation-check",
+        '''
+        def f():
+            """Mirrors scheduler.go:100 (unverifiable: no checkout)."""
+        ''',
+        "karpenter_tpu/solver/x.py",
+        config=cfg,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# pytest-markers
+
+
+def test_pytest_markers_flags_unregistered():
+    cfg = Config(repo_root=REPO_ROOT, markers=frozenset({"faults"}))
+    findings = run_rule(
+        "pytest-markers",
+        """
+        import pytest
+
+        @pytest.mark.fautls
+        def test_x():
+            pass
+        """,
+        "tests/test_x.py",
+        config=cfg,
+    )
+    assert len(findings) == 1 and "fautls" in findings[0].message
+
+
+def test_pytest_markers_allows_registered_and_builtin():
+    cfg = Config(repo_root=REPO_ROOT, markers=frozenset({"faults", "slow"}))
+    findings = run_rule(
+        "pytest-markers",
+        """
+        import pytest
+
+        pytestmark = [pytest.mark.faults, pytest.mark.slow]
+
+        @pytest.mark.parametrize("x", [1, 2])
+        def test_x(x):
+            pass
+        """,
+        "tests/test_x.py",
+        config=cfg,
+    )
+    assert findings == []
+
+
+def test_registered_markers_parsed_from_pyproject():
+    cfg = Config.for_repo(REPO_ROOT)
+    assert {"slow", "faults", "hard_timeout"} <= cfg.markers
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline mechanics
+
+
+def test_inline_suppression_silences_rule():
+    findings = run_rule(
+        "milli-units",
+        """
+        def shave(requests):
+            return requests["cpu"] / 2  # graftlint: disable=milli-units
+        """,
+        "karpenter_tpu/controllers/provisioning.py",
+    )
+    assert findings == []
+
+
+def test_def_line_suppression_covers_body():
+    findings = run_rule(
+        "milli-units",
+        """
+        # graftlint: disable=milli-units  price math is float by design
+        def price(requests):
+            a = requests["cpu"] / 2
+            b = requests["memory"] / 4
+            return a + b
+        """,
+        "karpenter_tpu/controllers/provisioning.py",
+    )
+    assert findings == []
+
+
+def test_standalone_suppression_skips_blanks_and_decorators():
+    """A standalone disable comment shields the next CODE line, across
+    blank lines / further comments, and covers a decorated def's body."""
+    findings = run_rule(
+        "milli-units",
+        """
+        # graftlint: disable=milli-units  price math is float by design
+
+        # (another comment in between)
+        @staticmethod
+        def price(requests):
+            return requests["cpu"] / 2
+        """,
+        "karpenter_tpu/controllers/provisioning.py",
+    )
+    assert findings == []
+
+
+def test_lock_discipline_sees_bare_lock_import():
+    findings = run_rule(
+        "lock-discipline",
+        """
+        from threading import Lock
+
+        class Server:
+            def __init__(self):
+                self._lock = Lock()
+                self.count = 0
+
+            def bump(self):
+                self.count += 1  # unguarded RMW must still be seen
+        """,
+        "karpenter_tpu/solver/service.py",
+    )
+    assert len(findings) == 1
+
+
+def test_suppression_is_rule_specific():
+    findings = run_rule(
+        "milli-units",
+        """
+        def shave(requests):
+            return requests["cpu"] / 2  # graftlint: disable=dtype-overflow
+        """,
+        "karpenter_tpu/controllers/provisioning.py",
+    )
+    assert len(findings) == 1
+
+
+def test_baseline_matches_by_text_and_reports_stale():
+    from karpenter_tpu.analysis.engine import Finding
+
+    f1 = Finding("r", "a.py", 10, "m", "x = y / 2")
+    bl = Baseline(
+        [
+            {"rule": "r", "path": "a.py", "text": "x = y / 2", "justification": "ok"},
+            {"rule": "r", "path": "a.py", "text": "gone()", "justification": "ok"},
+        ]
+    )
+    fresh, stale = bl.apply([f1])
+    assert fresh == []
+    assert [e["text"] for e in stale] == ["gone()"]
+    assert bl.unjustified() == []
+
+
+# ---------------------------------------------------------------------------
+# the real tree
+
+
+def test_repo_is_clean_under_graftlint():
+    """The acceptance gate: zero unbaselined findings, no stale or
+    unjustified baseline entries, no parse errors."""
+    report = run_analysis(REPO_ROOT)
+    assert report["errors"] == []
+    assert [f.render() for f in report["findings"]] == []
+    assert report["stale"] == []
+    assert report["unjustified"] == []
+
+
+def test_every_rule_has_fixture_coverage_here():
+    """Adding a rule without positive/negative fixtures fails this."""
+    covered = {
+        "shared-comparator",
+        "kernel-purity",
+        "tracer-leak",
+        "dtype-overflow",
+        "milli-units",
+        "lock-discipline",
+        "cache-invalidation",
+        "citation-check",
+        "pytest-markers",
+    }
+    assert {r.id for r in all_rules()} == covered
+
+
+def test_cli_exits_zero_on_clean_tree(capsys):
+    assert graftlint_main(["--root", REPO_ROOT]) == 0
+    out = capsys.readouterr().out
+    assert "0 findings" in out
+
+
+def test_cli_json_mode(capsys):
+    assert graftlint_main(["--root", REPO_ROOT, "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["findings"] == [] and data["baselined"] >= 10
+
+
+def test_cli_exits_nonzero_on_seeded_violation(tmp_path, capsys):
+    pkg = tmp_path / "karpenter_tpu" / "controllers"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "def shave(requests):\n    return requests['cpu'] / 2\n"
+    )
+    assert graftlint_main(["--root", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "milli-units" in out
+
+
+def test_write_baseline_preserves_existing_justifications(tmp_path, capsys):
+    pkg = tmp_path / "karpenter_tpu" / "controllers"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "def shave(requests):\n"
+        "    a = requests['cpu'] / 2\n"
+        "    b = requests['memory'] / 4\n"
+        "    return a, b\n"
+    )
+    bl = tmp_path / "graftlint.baseline.json"
+    bl.write_text(
+        json.dumps(
+            {
+                "entries": [
+                    {
+                        "rule": "milli-units",
+                        "path": "karpenter_tpu/controllers/bad.py",
+                        "text": "a = requests['cpu'] / 2",
+                        "justification": "curated reason that must survive",
+                    }
+                ]
+            }
+        )
+    )
+    assert graftlint_main(["--root", str(tmp_path), "--write-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads(bl.read_text())
+    by_text = {e["text"]: e["justification"] for e in data["entries"]}
+    assert by_text["a = requests['cpu'] / 2"] == "curated reason that must survive"
+    assert by_text["b = requests['memory'] / 4"].startswith("TODO")
+
+
+def test_write_baseline_refuses_subset_runs(tmp_path, capsys):
+    """A subset run sees a slice of the findings; rewriting the baseline
+    from it would truncate every out-of-scope curated entry."""
+    pkg = tmp_path / "karpenter_tpu"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("x = 1\n")
+    rc = graftlint_main(
+        ["--root", str(tmp_path), str(pkg / "ok.py"), "--write-baseline"]
+    )
+    assert rc == 2
+    assert not (tmp_path / "graftlint.baseline.json").exists()
+
+
+def test_analysis_package_does_not_import_jax():
+    """The lint gate must stay device-free (seconds, not a jax warmup)."""
+    code = (
+        "import sys; import karpenter_tpu.analysis; "
+        "from karpenter_tpu.analysis.__main__ import main; "
+        "assert 'jax' not in sys.modules, 'analysis imported jax'; "
+        "assert 'numpy' not in sys.modules, 'analysis imported numpy'"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert res.returncode == 0, res.stderr
