@@ -1,23 +1,34 @@
-"""graftlint — the AST-based invariant analyzer for this codebase.
+"""graftlint — the two-tier invariant analyzer for this codebase.
 
-Mechanically enforces the architecture contracts documented in CLAUDE.md
-and the gate comments atop solver/tpu_runs.py: shared FFD comparator
-parity, kernel trace purity, int32-overflow guards in the consolidation
-sweep, integer milli-unit resources, lock discipline at the service
-boundary, `_ktpu_*` cache invalidation on relax mutations, reference
-citation hygiene, and pytest marker registration.
+The AST tier mechanically enforces the source-level architecture
+contracts documented in CLAUDE.md and the gate comments atop
+solver/tpu_runs.py: shared FFD comparator parity, kernel trace purity,
+int32-overflow guards in the consolidation sweep, integer milli-unit
+resources, lock discipline at the service boundary, `_ktpu_*` cache
+invalidation on relax mutations, reference citation hygiene, and pytest
+marker registration.
 
-Pure stdlib `ast` — importing this package MUST NOT import JAX or numpy
-(tests/test_static_analysis.py pins this), so the lint gate runs in
-seconds with no device/tunnel involvement.
+The IR tier (analysis/ir.py, `--ir`) traces the real solver kernels on
+small representative problems and walks the jaxprs: forbidden host
+callbacks, 64-bit/weak-type avals, loop-carry byte budgets from the
+checked-in kernel_budgets.json (analysis/budgets.py), the
+trace-time-static relax contract, and per-solve upload/retrace
+accounting.
+
+Importing THIS package MUST NOT import JAX or numpy
+(tests/test_static_analysis.py pins this) — the AST gate runs in seconds
+with no device/tunnel involvement; only analysis/ir.py imports JAX, and
+only when loaded explicitly (the CLI does so under `--ir`).
 
 Usage:
-    python -m karpenter_tpu.analysis            # lint package + tests
+    python -m karpenter_tpu.analysis            # AST: lint package + tests
     python -m karpenter_tpu.analysis --json     # machine-readable
     python -m karpenter_tpu.analysis --changed-only   # pre-commit mode
+    python -m karpenter_tpu.analysis --ir       # IR: trace kernels + budgets
 
-Rules, suppression syntax (`# graftlint: disable=<rule>`) and the
-baseline workflow are documented in docs/static-analysis.md.
+Rules, suppression syntax (`# graftlint: disable=<rule>`), the baseline
+workflow, and the budget manifest are documented in
+docs/static-analysis.md.
 """
 
 from karpenter_tpu.analysis.engine import (

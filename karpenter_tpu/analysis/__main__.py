@@ -1,8 +1,16 @@
 """graftlint CLI: `python -m karpenter_tpu.analysis` (also installed as
 the `graftlint` console script).
 
+Two tiers share this entry point:
+
+- the AST tier (default): stdlib-`ast` source analysis, JAX-free;
+- the IR tier (`--ir`): traces the real solver kernels and walks the
+  jaxprs (analysis/ir.py) — imports JAX, needs JAX_PLATFORMS=cpu or a
+  device, and enforces kernel_budgets.json (`--write-budgets` to
+  re-baseline after an intentional kernel change).
+
 Exit codes: 0 clean (baseline-covered findings allowed), 1 findings or
-stale/unjustified baseline entries, 2 usage or parse errors.
+stale/unjustified baseline or budget entries, 2 usage/parse/trace errors.
 """
 
 from __future__ import annotations
@@ -16,6 +24,7 @@ import sys
 from karpenter_tpu.analysis.engine import (
     Baseline,
     all_rules,
+    canonical_json,
     run_analysis,
 )
 
@@ -25,6 +34,42 @@ def _detect_repo_root() -> str:
     return os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
+
+
+def _json_files_parse(*paths: str) -> bool:
+    """Pre-flight the hand-editable JSON inputs (baselines, budgets): a
+    trailing-comma typo must surface as the documented exit-2 parse
+    diagnostic naming the file, not a raw JSONDecodeError traceback."""
+    ok = True
+    for p in paths:
+        if not p or not os.path.exists(p):
+            continue
+        try:
+            with open(p, encoding="utf-8") as f:
+                json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            print(f"graftlint: cannot parse {p}: {e}", file=sys.stderr)
+            ok = False
+    return ok
+
+
+def _write_baseline_file(baseline_path: str, findings) -> int:
+    """Shared --write-baseline tail for both tiers: regeneration keeps
+    hand-written justifications (entries that still match a finding carry
+    their text over; only genuinely new findings get the TODO
+    placeholder)."""
+    existing = Baseline.load(baseline_path)
+    data = Baseline.render_entries(findings)
+    fresh = existing.merge_justifications(data)
+    with open(baseline_path, "w", encoding="utf-8") as f:
+        f.write(canonical_json(data))
+    print(
+        f"graftlint: wrote {len(data['entries'])} entr"
+        f"{'y' if len(data['entries']) == 1 else 'ies'} to "
+        f"{baseline_path}"
+        + (f" — justify the {fresh} new one(s)" if fresh else "")
+    )
+    return 0
 
 
 def _changed_files(repo_root: str):
@@ -92,14 +137,39 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="list rule ids and exit"
     )
+    parser.add_argument(
+        "--ir",
+        action="store_true",
+        help="run the IR tier: trace the solver kernels and walk the "
+        "jaxprs (imports JAX; see docs/static-analysis.md)",
+    )
+    parser.add_argument(
+        "--budgets",
+        default=None,
+        help="IR budget manifest (default: <root>/kernel_budgets.json)",
+    )
+    parser.add_argument(
+        "--write-budgets",
+        action="store_true",
+        help="re-baseline kernel_budgets.json from current measurements "
+        "(implies --ir; justify each changed entry!)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for r in all_rules():
             print(f"{r.id:20s} {r.summary}")
+        from karpenter_tpu.analysis.ir import IR_RULES
+
+        for rid, summary in IR_RULES.items():
+            print(f"{rid:20s} [ir] {summary}")
         return 0
 
     repo_root = os.path.abspath(args.root or _detect_repo_root())
+    if args.write_budgets:
+        args.ir = True
+    if args.ir:
+        return _main_ir(args, repo_root)
     paths = [os.path.abspath(p) for p in args.paths] or None
     if args.changed_only:
         paths = _changed_files(repo_root)
@@ -111,9 +181,22 @@ def main(argv=None) -> int:
     rule_ids = (
         {r.strip() for r in args.rules.split(",")} if args.rules else None
     )
+    if rule_ids is not None:
+        # a typo'd rule id must not read as "nothing to check, clean"
+        unknown = rule_ids - {r.id for r in all_rules()}
+        if unknown:
+            print(
+                "graftlint: unknown rule id(s): "
+                + ", ".join(sorted(unknown))
+                + " (see --list-rules; ir-* rules need --ir)",
+                file=sys.stderr,
+            )
+            return 2
     baseline_path = args.baseline or os.path.join(
         repo_root, "graftlint.baseline.json"
     )
+    if not _json_files_parse(baseline_path):
+        return 2
 
     report = run_analysis(
         repo_root,
@@ -124,48 +207,27 @@ def main(argv=None) -> int:
     )
 
     if args.write_baseline:
-        if paths is not None:
+        if paths is not None or rule_ids is not None:
             # a subset run sees only a slice of the findings; rewriting
             # from it would truncate every out-of-scope curated entry
             print(
-                "graftlint: --write-baseline requires a full-tree run "
-                "(no explicit paths / --changed-only)",
+                "graftlint: --write-baseline requires a full-tree, "
+                "all-rules run (no explicit paths / --changed-only / "
+                "--rules)",
                 file=sys.stderr,
             )
             return 2
-        # regeneration must keep hand-written justifications: entries that
-        # still match a finding carry their text over, only genuinely new
-        # findings get the TODO placeholder
-        existing = Baseline.load(baseline_path)
-        keep: dict[tuple, list[str]] = {}
-        for e in existing.entries:
-            k = (e.get("rule"), e.get("path"), e.get("text"))
-            keep.setdefault(k, []).append(str(e.get("justification", "")))
-        data = Baseline.render_entries(report["all_findings"])
-        fresh = 0
-        for entry in data["entries"]:
-            k = (entry["rule"], entry["path"], entry["text"])
-            bucket = keep.get(k)
-            if bucket:
-                entry["justification"] = bucket.pop(0)
-            else:
-                fresh += 1
-        with open(baseline_path, "w", encoding="utf-8") as f:
-            json.dump(data, f, indent=2)
-            f.write("\n")
-        print(
-            f"graftlint: wrote {len(data['entries'])} entr"
-            f"{'y' if len(data['entries']) == 1 else 'ies'} to "
-            f"{baseline_path}"
-            + (f" — justify the {fresh} new one(s)" if fresh else "")
-        )
-        return 0
+        return _write_baseline_file(baseline_path, report["all_findings"])
 
     findings = report["findings"]
-    # subset runs (--changed-only, explicit paths) leave baseline entries
-    # for out-of-scope files unmatched — that is expected, not staleness;
-    # only the default full-tree run polices baseline rot
-    stale = [] if paths is not None else report["stale"]
+    # subset runs (--changed-only, explicit paths, --rules) leave baseline
+    # entries for out-of-scope files or rules unmatched — that is
+    # expected, not staleness; only the default full run polices rot
+    stale = (
+        []
+        if (paths is not None or rule_ids is not None)
+        else report["stale"]
+    )
     unjustified = report["unjustified"]
     errors = report["errors"]
 
@@ -209,6 +271,174 @@ def main(argv=None) -> int:
         return 1
     if errors:
         return 2
+    return 0
+
+
+def _main_ir(args: argparse.Namespace, repo_root: str) -> int:
+    """The `--ir` tier (analysis/ir.py): trace kernels, enforce
+    kernel_budgets.json, apply graftlint.ir.baseline.json."""
+    if args.paths or args.changed_only:
+        # IR rules trace kernel entry points, not files — a path subset
+        # has no meaning and must not read as a clean run
+        print(
+            "graftlint: --ir traces kernel entry points; it takes no "
+            "paths and no --changed-only",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        from karpenter_tpu.analysis import budgets as budgets_mod
+        from karpenter_tpu.analysis import ir
+    except ImportError as e:
+        print(f"graftlint: IR tier unavailable ({e})", file=sys.stderr)
+        return 2
+
+    rule_ids = (
+        {r.strip() for r in args.rules.split(",")} if args.rules else None
+    )
+    if rule_ids is not None:
+        # a typo'd id would intersect IR_RULES to the empty set: the tier
+        # would measure nothing and exit 0 — a silently disabled gate
+        unknown = rule_ids - set(ir.IR_RULES)
+        if unknown:
+            print(
+                "graftlint: unknown IR rule id(s): "
+                + ", ".join(sorted(unknown))
+                + " (see --list-rules)",
+                file=sys.stderr,
+            )
+            return 2
+    budgets_path = args.budgets or os.path.join(
+        repo_root, budgets_mod.DEFAULT_MANIFEST
+    )
+    baseline_path = args.baseline or os.path.join(
+        repo_root, "graftlint.ir.baseline.json"
+    )
+    if not _json_files_parse(budgets_path, baseline_path):
+        return 2
+
+    if args.write_budgets:
+        if rule_ids is not None:
+            # a partial run measures a slice; rewriting from it would
+            # truncate every out-of-scope entry
+            print(
+                "graftlint: --write-budgets requires a full IR run "
+                "(no --rules)",
+                file=sys.stderr,
+            )
+            return 2
+        measured, _, errors = ir.measure(None)
+        if errors:
+            for e in errors:
+                print(f"trace error: {e}", file=sys.stderr)
+            return 2
+        existing = budgets_mod.BudgetManifest.load(budgets_path)
+        data = budgets_mod.BudgetManifest.render(measured, existing)
+        fresh = sum(
+            1
+            for e in data["entries"].values()
+            if str(e["justification"]).startswith("TODO")
+        )
+        with open(budgets_path, "w", encoding="utf-8") as f:
+            f.write(budgets_mod.BudgetManifest.dumps(data))
+        print(
+            f"graftlint: wrote {len(data['entries'])} budget entr"
+            f"{'y' if len(data['entries']) == 1 else 'ies'} to "
+            f"{budgets_path}"
+            + (f" — justify the {fresh} new one(s)" if fresh else "")
+        )
+        return 0
+
+    report = ir.run_ir_analysis(
+        repo_root,
+        budgets_path=budgets_path,
+        baseline_path=baseline_path,
+        rule_ids=rule_ids,
+    )
+
+    if args.write_baseline:
+        if rule_ids is not None:
+            # a partial run sees a slice of the findings; rewriting from
+            # it would truncate every out-of-scope curated entry
+            print(
+                "graftlint: --write-baseline under --ir requires a full "
+                "IR run (no --rules)",
+                file=sys.stderr,
+            )
+            return 2
+        if report["errors"]:
+            # a partial measurement must never rewrite the baseline as if
+            # the errored kernel's findings were resolved
+            for e in report["errors"]:
+                print(f"trace error: {e}", file=sys.stderr)
+            return 2
+        return _write_baseline_file(baseline_path, report["all_findings"])
+
+    findings = report["findings"]
+    # partial runs (--rules) leave baseline entries for out-of-scope
+    # rules unmatched — expected, not staleness (the AST tier's subset
+    # convention); only the full run polices baseline rot
+    stale = [] if rule_ids is not None else report["stale"]
+    unjustified = report["unjustified"]
+    budget_unjustified = report["budget_unjustified"]
+    errors = report["errors"]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "stale_baseline": stale,
+                    "unjustified_baseline": unjustified,
+                    "unjustified_budgets": budget_unjustified,
+                    "improvements": report["improvements"],
+                    "errors": errors,
+                    "measured": report["measured"],
+                    "baselined": len(report["all_findings"])
+                    - len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        for e in stale:
+            print(
+                f"stale baseline entry: [{e.get('rule')}] {e.get('path')}: "
+                f"{e.get('text')!r} no longer matches — remove it"
+            )
+        for e in unjustified:
+            print(
+                f"unjustified baseline entry: [{e.get('rule')}] "
+                f"{e.get('path')}: add a one-line justification"
+            )
+        for name in budget_unjustified:
+            print(
+                f"unjustified budget entry: {name}: add a one-line "
+                "justification in kernel_budgets.json"
+            )
+        for e in errors:
+            print(f"trace error: {e}")
+        baselined = len(report["all_findings"]) - len(findings)
+        print(
+            f"graftlint --ir: {len(findings)} finding"
+            f"{'' if len(findings) == 1 else 's'}, "
+            f"{len(report['measured'])} entry points measured"
+            + (f", {baselined} baselined" if baselined else "")
+            + (
+                f", {len(report['improvements'])} budget(s) with slack"
+                if report["improvements"]
+                else ""
+            )
+        )
+
+    if errors:
+        # a kernel that no longer traces is a broken gate, not a lint
+        # verdict — exit 2 even when comparison findings also exist
+        return 2
+    if findings or stale or unjustified or budget_unjustified:
+        return 1
     return 0
 
 

@@ -1,0 +1,235 @@
+"""kernel_budgets.json: the IR tier's checked-in budget manifest.
+
+The AST tier's baseline grandfathers *findings*; this manifest pins
+*measurements* — loop-carry bytes, loop structure, upload and retrace
+counts taken from the traced solver kernels (analysis/ir.py). Both follow
+the same workflow: every entry carries a one-line justification, stale or
+orphaned entries fail the gate so the file cannot rot, and re-baselining
+is an explicit `graftlint --ir --write-budgets` followed by justifying
+the diff.
+
+Metric policy — two kinds, declared in `METRIC_POLICY`:
+
+- `exact`: the measured value must EQUAL the budget. Used for structure
+  (while/scan counts: an extra device loop is a compiled-program change
+  that needs a justified re-baseline even when it is "better") and for
+  absolute contracts (second-solve retraces, per-solve table uploads).
+- `ceiling`: the measured value must not EXCEED the budget. Used for byte
+  and iteration totals, where warm in-process caches can legitimately
+  lower a measurement (a pytest run that already compiled a kernel traces
+  less than a cold CLI run) but growth is always a regression.
+
+Pure stdlib — importable without JAX so the manifest mechanics are
+testable in milliseconds (tests/test_budget_manifest.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from karpenter_tpu.analysis.engine import canonical_json
+
+DEFAULT_MANIFEST = "kernel_budgets.json"
+
+# metric name -> enforcement policy; a manifest metric outside this table
+# is reported as unknown (the manifest rotted or the tool regressed)
+METRIC_POLICY: dict[str, str] = {
+    # jaxpr structure (analysis/ir.py kernel_metrics)
+    "while_loops": "exact",
+    "scans": "exact",
+    "max_carry_bytes": "ceiling",
+    "total_carry_bytes": "ceiling",
+    "scan_total_length": "ceiling",
+    # runtime accounting (analysis/ir.py runtime_metrics)
+    "table_uploads": "exact",
+    "pod_table_uploads": "exact",
+    "pod_batch_uploads": "ceiling",
+    "first_solve_traces": "ceiling",
+    "second_solve_traces": "exact",
+    "second_solve_compiles": "exact",
+}
+
+
+@dataclasses.dataclass
+class BudgetIssue:
+    """One manifest-vs-measurement discrepancy."""
+
+    kind: str  # regression | structure-mismatch | missing-entry |
+    #            orphaned-entry | unknown-metric | missing-metric
+    entry: str
+    metric: Optional[str]
+    budget: Optional[int]
+    measured: Optional[int]
+
+    def render(self) -> str:
+        if self.kind == "regression":
+            return (
+                f"{self.entry}: {self.metric} regressed — measured "
+                f"{self.measured} exceeds the budget {self.budget} "
+                "(--write-budgets to re-baseline, then justify)"
+            )
+        if self.kind == "structure-mismatch":
+            return (
+                f"{self.entry}: {self.metric} changed — measured "
+                f"{self.measured}, budget pins {self.budget} (loop "
+                "structure is exact-match; re-baseline with justification)"
+            )
+        if self.kind == "missing-entry":
+            return (
+                f"{self.entry}: no budget entry — new kernel entry point; "
+                "run --write-budgets and justify it"
+            )
+        if self.kind == "orphaned-entry":
+            return (
+                f"{self.entry}: budget entry matches no traced entry point "
+                "— remove it (the kernel moved or was renamed)"
+            )
+        if self.kind == "missing-metric":
+            return (
+                f"{self.entry}: budget has no `{self.metric}` value but the "
+                "tool measures it — re-baseline"
+            )
+        if self.kind == "improvement":
+            return (
+                f"{self.entry}: {self.metric} measured {self.measured} is "
+                f"under the budget {self.budget} — consider tightening the "
+                "ceiling (--write-budgets)"
+            )
+        return (
+            f"{self.entry}: unknown metric `{self.metric}` in the manifest "
+            "— remove it"
+        )
+
+
+@dataclasses.dataclass
+class Comparison:
+    issues: list[BudgetIssue]
+    # measured strictly under a ceiling budget: legitimate (warm caches,
+    # real improvements) but worth surfacing so ceilings get tightened
+    improvements: list[BudgetIssue]
+
+
+class BudgetManifest:
+    """Load/compare/render kernel_budgets.json.
+
+    Schema:
+        {"entries": {"<entry point>": {
+            "justification": "<one line>",
+            "metrics": {"<metric>": <int>, ...}}}}
+    Serialization is canonical (engine.canonical_json) so a re-written
+    manifest with unchanged content is byte-identical — the round-trip
+    property tests/test_budget_manifest.py pins.
+    """
+
+    def __init__(
+        self, entries: dict[str, dict], path: Optional[str] = None
+    ):
+        self.entries = entries
+        self.path = path
+
+    @classmethod
+    def load(cls, path: str) -> "BudgetManifest":
+        if not os.path.exists(path):
+            return cls({}, path)
+        import json
+
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(dict(data.get("entries", {})), path)
+
+    def unjustified(self) -> list[str]:
+        """Entry names whose justification is empty or a TODO placeholder
+        (same policing as graftlint.baseline.json)."""
+        out = []
+        for name, e in self.entries.items():
+            j = str(e.get("justification", "")).strip()
+            if not j or j.startswith("TODO"):
+                out.append(name)
+        return sorted(out)
+
+    def compare(self, measured: dict[str, dict[str, int]]) -> Comparison:
+        issues: list[BudgetIssue] = []
+        improvements: list[BudgetIssue] = []
+        for name in sorted(measured):
+            entry = self.entries.get(name)
+            if entry is None:
+                issues.append(
+                    BudgetIssue("missing-entry", name, None, None, None)
+                )
+                continue
+            budget_metrics = dict(entry.get("metrics", {}))
+            for metric in sorted(measured[name]):
+                got = int(measured[name][metric])
+                if metric not in budget_metrics:
+                    issues.append(
+                        BudgetIssue("missing-metric", name, metric, None, got)
+                    )
+                    continue
+                want = int(budget_metrics.pop(metric))
+                policy = METRIC_POLICY.get(metric)
+                if policy == "exact":
+                    if got != want:
+                        issues.append(
+                            BudgetIssue(
+                                "structure-mismatch", name, metric, want, got
+                            )
+                        )
+                elif policy == "ceiling":
+                    if got > want:
+                        issues.append(
+                            BudgetIssue("regression", name, metric, want, got)
+                        )
+                    elif got < want:
+                        improvements.append(
+                            BudgetIssue(
+                                "improvement", name, metric, want, got
+                            )
+                        )
+                else:
+                    issues.append(
+                        BudgetIssue("unknown-metric", name, metric, want, got)
+                    )
+            for metric in sorted(budget_metrics):
+                # budgeted but no longer measured: the tool dropped the
+                # metric or the manifest carries a typo — police it
+                issues.append(
+                    BudgetIssue(
+                        "unknown-metric",
+                        name,
+                        metric,
+                        int(budget_metrics[metric]),
+                        None,
+                    )
+                )
+        for name in sorted(set(self.entries) - set(measured)):
+            issues.append(
+                BudgetIssue("orphaned-entry", name, None, None, None)
+            )
+        return Comparison(issues=issues, improvements=improvements)
+
+    @staticmethod
+    def render(
+        measured: dict[str, dict[str, int]],
+        existing: Optional["BudgetManifest"] = None,
+    ) -> dict:
+        """Manifest dict for --write-budgets. Entries that already exist
+        keep their hand-written justification (the --write-baseline
+        convention); genuinely new ones get the TODO placeholder."""
+        entries = {}
+        for name in sorted(measured):
+            old = (existing.entries.get(name) if existing else None) or {}
+            entries[name] = {
+                "justification": str(
+                    old.get("justification", "TODO: justify or fix")
+                ),
+                "metrics": {
+                    m: int(v) for m, v in sorted(measured[name].items())
+                },
+            }
+        return {"entries": entries}
+
+    @staticmethod
+    def dumps(data: dict) -> str:
+        return canonical_json(data)

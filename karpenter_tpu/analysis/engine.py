@@ -47,7 +47,7 @@ class Finding:
     message: str
     text: str  # stripped source line — the baseline identity
 
-    def key(self) -> tuple:
+    def key(self) -> tuple[str, str, str]:
         return (self.rule, self.path, self.text)
 
     def to_dict(self) -> dict:
@@ -64,10 +64,12 @@ class Config:
     repo_root: str
     reference_root: str = "/root/reference"
     # pytest markers registered in pyproject.toml (pytest-markers rule)
-    markers: frozenset = frozenset()
+    markers: frozenset[str] = frozenset()
 
     @classmethod
-    def for_repo(cls, repo_root: str, reference_root: str = "/root/reference"):
+    def for_repo(
+        cls, repo_root: str, reference_root: str = "/root/reference"
+    ) -> "Config":
         return cls(
             repo_root=repo_root,
             reference_root=reference_root,
@@ -77,7 +79,7 @@ class Config:
         )
 
 
-def load_registered_markers(pyproject_path: str) -> frozenset:
+def load_registered_markers(pyproject_path: str) -> frozenset[str]:
     """Marker names from [tool.pytest.ini_options] markers. Regex, not
     tomllib — the floor interpreter is 3.10 (pyproject requires-python)."""
     try:
@@ -272,6 +274,18 @@ def ordering_import_names(tree: ast.Module) -> set[str]:
 
 
 # ---------------------------------------------------------------------------
+# canonical serialization (shared by the AST baseline and the IR tier's
+# kernel_budgets.json: sorted keys, two-space indent, trailing newline —
+# a re-written file with unchanged content is byte-identical)
+
+
+def canonical_json(data: dict) -> str:
+    return (
+        json.dumps(data, indent=2, sort_keys=True, ensure_ascii=False) + "\n"
+    )
+
+
+# ---------------------------------------------------------------------------
 # baseline
 
 
@@ -330,6 +344,26 @@ class Baseline:
                 for f in findings
             ]
         }
+
+    def merge_justifications(self, data: dict) -> int:
+        """Carry hand-written justifications from this baseline into a
+        freshly rendered `data` (render_entries output): entries that
+        still match keep their text, only genuinely new findings keep the
+        TODO placeholder. Returns the number of new entries. Shared by
+        the AST `--write-baseline` and the IR tier's baseline writer."""
+        keep: dict[tuple, list[str]] = {}
+        for e in self.entries:
+            k = (e.get("rule"), e.get("path"), e.get("text"))
+            keep.setdefault(k, []).append(str(e.get("justification", "")))
+        fresh = 0
+        for entry in data["entries"]:
+            k = (entry["rule"], entry["path"], entry["text"])
+            bucket = keep.get(k)
+            if bucket:
+                entry["justification"] = bucket.pop(0)
+            else:
+                fresh += 1
+        return fresh
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,798 @@
+"""graftlint IR tier: jaxpr-level kernel contract analyzer.
+
+The AST tier (analysis/engine.py + rules_*) sees source text; the
+contracts that actually govern solver performance — trace-time statics,
+loop-carry bytes (the carry is copied every device iteration), one
+upload of the per-class tables per solve, int32-only device dtypes —
+live in what XLA compiles, which `ast` cannot see. This module traces
+the REAL solver entry points on small representative problems, walks the
+resulting jaxprs, and enforces measured budgets from the checked-in
+`kernel_budgets.json` (analysis/budgets.py).
+
+Rules:
+
+- `ir-callbacks`: no `pure_callback`/`io_callback`/`debug_callback`/
+  infeed/outfeed primitives anywhere in a jitted solver program — a host
+  callback inside the kernel rides the slow host<->device tunnel once per
+  invocation and defeats the dense-tensor design.
+- `ir-dtype`: no 64-bit avals on device (the documented int64 overflow
+  guards are HOST-side numpy and never appear in a jaxpr) and no
+  weakly-typed loop carries (a weak carry re-promotes per iteration and
+  destabilizes the compiled-shape identity).
+- `ir-carry-budget`: loop-carry bytes and while/scan structure, computed
+  from the traced program's carry avals, pinned by kernel_budgets.json.
+- `ir-retrace`: the trace-time-static contract — a zero-preference
+  problem compiles the plain step (`relax=True` adds EXACTLY one
+  while loop: the tier ladder; more means the step got duplicated, the
+  historical cond(plain, tiers) bug), and a repeated same-shape solve
+  causes zero retraces and zero compiles (budgeted exact-0).
+- `ir-transfer`: per-solve upload accounting — the per-class tables ship
+  exactly once per solve (`TpuScheduler._upload_pod_tables` contract)
+  and per-round pod batches stay within budget.
+
+Unlike the rest of the analysis package this module DOES import JAX
+(lazily, inside functions): `import karpenter_tpu.analysis` stays
+JAX-free (the no-JAX subprocess test pins it), and the CLI only loads
+this module under `--ir`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from karpenter_tpu.analysis import budgets as budgets_mod
+from karpenter_tpu.analysis.engine import Finding
+
+IR_RULES: dict[str, str] = {
+    "ir-callbacks": (
+        "no host-callback/infeed/outfeed primitives in jitted solver "
+        "programs"
+    ),
+    "ir-dtype": (
+        "no 64-bit avals on device; loop carries must not be weakly typed"
+    ),
+    "ir-carry-budget": (
+        "loop-carry bytes and while/scan structure pinned by "
+        "kernel_budgets.json"
+    ),
+    "ir-retrace": (
+        "trace-time-static contract: relax adds exactly one while loop; "
+        "a repeated same-shape solve retraces nothing"
+    ),
+    "ir-transfer": (
+        "per-class tables upload once per solve; per-round batch uploads "
+        "within budget"
+    ),
+}
+
+# metric -> owning rule (budget comparisons surface under the rule whose
+# contract the metric measures)
+_METRIC_RULE = {
+    "table_uploads": "ir-transfer",
+    "pod_table_uploads": "ir-transfer",
+    "pod_batch_uploads": "ir-transfer",
+    "first_solve_traces": "ir-retrace",
+    "second_solve_traces": "ir-retrace",
+    "second_solve_compiles": "ir-retrace",
+}
+
+_FORBIDDEN_EXACT = frozenset(
+    {"infeed", "outfeed", "outside_call", "host_local_array_to_global_array"}
+)
+
+
+def is_forbidden_primitive(name: str) -> bool:
+    """pure_callback / io_callback / debug_callback / any *callback*
+    primitive, plus the explicit host-transfer ops."""
+    return "callback" in name or name in _FORBIDDEN_EXACT
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking (duck-typed on jaxpr structure; no jax import required, so
+# the helpers are unit-testable against hand-built stand-ins)
+
+
+def _closed(j: Any) -> Any:
+    """ClosedJaxpr -> Jaxpr; Jaxpr passes through."""
+    return j.jaxpr if hasattr(j, "jaxpr") and hasattr(j.jaxpr, "eqns") else j
+
+
+def _subjaxprs(eqn: Any) -> Iterator[Any]:
+    """Inner jaxprs of one equation (pjit/scan `jaxpr`, while
+    `cond_jaxpr`/`body_jaxpr`, cond `branches`, ...)."""
+    for v in eqn.params.values():
+        for s in v if isinstance(v, (list, tuple)) else (v,):
+            if hasattr(s, "eqns"):
+                yield s
+            elif hasattr(s, "jaxpr") and hasattr(s.jaxpr, "eqns"):
+                yield s.jaxpr
+
+
+def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+    """Every equation in the program, recursing into sub-jaxprs."""
+    for eqn in _closed(jaxpr).eqns:
+        yield eqn
+        for sub in _subjaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def aval_bytes(aval: Any) -> int:
+    n = 1
+    for d in aval.shape:
+        n *= int(d)
+    return n * aval.dtype.itemsize
+
+
+@dataclasses.dataclass
+class LoopStat:
+    """One device loop (lax.scan or lax.while_loop) in a traced program."""
+
+    kind: str  # "scan" | "while"
+    length: Optional[int]  # scan trip count; None for while
+    carry_bytes: int
+    weak_carries: int  # carried avals with weak_type=True
+
+
+def loop_stats(jaxpr: Any) -> list[LoopStat]:
+    """Carry avals of every scan/while: the loop carry is copied every
+    device iteration, so carry bytes dominate per-step cost (CLAUDE.md
+    cost model) — this is the measurement kernel_budgets.json pins."""
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name == "scan":
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            inner = _closed(eqn.params["jaxpr"])
+            carry = inner.invars[nc : nc + ncar]
+            length = eqn.params.get("length")
+            length = int(length) if length is not None else None
+        elif name == "while":
+            inner = _closed(eqn.params["body_jaxpr"])
+            carry = inner.invars[eqn.params["body_nconsts"] :]
+            length = None
+        else:
+            continue
+        out.append(
+            LoopStat(
+                kind=name,
+                length=length,
+                carry_bytes=sum(aval_bytes(v.aval) for v in carry),
+                weak_carries=sum(
+                    1 for v in carry if getattr(v.aval, "weak_type", False)
+                ),
+            )
+        )
+    return out
+
+
+def forbidden_primitives(jaxpr: Any) -> list[str]:
+    found = []
+    for eqn in iter_eqns(jaxpr):
+        if is_forbidden_primitive(eqn.primitive.name):
+            found.append(eqn.primitive.name)
+    return sorted(set(found))
+
+
+def wide_dtypes(jaxpr: Any) -> list[str]:
+    """dtype names of any 8-byte aval appearing in the program."""
+    found = set()
+    for eqn in iter_eqns(jaxpr):
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            dtype = getattr(aval, "dtype", None)
+            if dtype is not None and dtype.itemsize == 8:
+                found.add(str(dtype))
+    return sorted(found)
+
+
+def kernel_metrics(jaxpr: Any) -> dict[str, int]:
+    """The budgeted structure/carry measurements for one traced program."""
+    stats = loop_stats(jaxpr)
+    return {
+        "while_loops": sum(1 for s in stats if s.kind == "while"),
+        "scans": sum(1 for s in stats if s.kind == "scan"),
+        "max_carry_bytes": max((s.carry_bytes for s in stats), default=0),
+        "total_carry_bytes": sum(s.carry_bytes for s in stats),
+        "scan_total_length": sum(s.length or 0 for s in stats),
+    }
+
+
+# ---------------------------------------------------------------------------
+# trace/compile event counter (jax.monitoring duration events fire once
+# per jaxpr trace / backend compile and NOT on cache hits — the counter
+# the retrace contract and tests/test_compilecache.py both ride)
+
+_COUNTS = {"traces": 0, "compiles": 0}
+_LISTENER_INSTALLED = False
+
+
+def _install_listener() -> None:
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    import jax
+
+    def _on_duration(name: str, secs: float, **kw: Any) -> None:
+        if name == "/jax/core/compile/jaxpr_trace_duration":
+            _COUNTS["traces"] += 1
+        elif name == "/jax/core/compile/backend_compile_duration":
+            _COUNTS["compiles"] += 1
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _LISTENER_INSTALLED = True
+
+
+class trace_events(contextlib.AbstractContextManager):
+    """Counts jaxpr traces and backend compiles inside the block.
+
+        with trace_events() as ev:
+            solve()
+        assert ev.traces == 0
+
+    Properties read live, so mid-block checkpoints work too. There is no
+    listener-unregister API in jax.monitoring — one module-level listener
+    feeds a global counter and contexts snapshot it."""
+
+    def __enter__(self) -> "trace_events":
+        _install_listener()
+        self._t0 = _COUNTS["traces"]
+        self._c0 = _COUNTS["compiles"]
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        return None
+
+    @property
+    def traces(self) -> int:
+        return _COUNTS["traces"] - self._t0
+
+    @property
+    def compiles(self) -> int:
+        return _COUNTS["compiles"] - self._c0
+
+
+@contextlib.contextmanager
+def count_method_calls(cls: type, names: Iterable[str]):
+    """Temporarily wrap methods of `cls` with call counters; yields the
+    live {name: count} dict. Restores the original methods on exit."""
+    counts = {n: 0 for n in names}
+    originals = {n: getattr(cls, n) for n in counts}
+
+    def _wrap(name: str, fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            counts[name] += 1
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    for n, fn in originals.items():
+        setattr(cls, n, _wrap(n, fn))
+    try:
+        yield counts
+    finally:
+        for n, fn in originals.items():
+            setattr(cls, n, fn)
+
+
+# ---------------------------------------------------------------------------
+# representative problems
+
+
+@dataclasses.dataclass
+class ProblemKit:
+    """One small encoded problem with every artifact the entry points
+    need. Built once per process (build_kit is cached): the kits are tiny
+    (6 pods, 3 existing nodes, 8 claim slots) so tracing stays in the
+    seconds range on JAX_PLATFORMS=cpu."""
+
+    sched: Any
+    problem: Any
+    tb: Any
+    st: Any
+    order: list
+    xs: Any
+    x_row: Any
+    idx_d: Any
+    n_d: Any
+    rx: Any
+    seq: Any
+    next_seq: Any
+    relax: bool
+
+
+def _make_views(n: int = 3) -> list:
+    from karpenter_tpu.api import labels as well_known
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver.nodes import StateNodeView
+
+    it = construct_instance_types(sizes=[2])[0]
+    return [
+        StateNodeView(
+            name=f"ir-existing-{i}",
+            node_labels={well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a"},
+            labels={
+                well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a",
+                well_known.INSTANCE_TYPE_LABEL_KEY: it.name,
+                well_known.NODEPOOL_LABEL_KEY: "default",
+            },
+            available=dict(it.allocatable()),
+            capacity=dict(it.capacity),
+            initialized=True,
+        )
+        for i in range(n)
+    ]
+
+
+def _make_pods(kind: str) -> list:
+    from karpenter_tpu.testing import fixtures
+
+    fixtures.reset_rng(7)
+    if kind == "generic":
+        return fixtures.make_generic_pods(6)
+    # mixed: relaxable preference pods AND plain pods in one batch — the
+    # shape the one-step-instance contract is about
+    return fixtures.make_generic_pods(3) + fixtures.make_preference_pods(3)
+
+
+def _make_sched(kind: str) -> tuple:
+    """(TpuScheduler, pods) for one representative problem — the SINGLE
+    construction both the jaxpr tier (build_kit) and the runtime
+    accounting (_runtime_solve) measure, so their budgets can never
+    silently describe different problems."""
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import TpuScheduler
+    from karpenter_tpu.testing import fixtures
+
+    fixtures.reset_rng(7)
+    its = construct_instance_types(sizes=[2])
+    pool = fixtures.node_pool(name="default")
+    pods = _make_pods(kind)
+    views = _make_views()
+    topo = Topology([pool], {"default": its}, pods, state_node_views=views)
+    return TpuScheduler([pool], {"default": its}, topo, views), pods
+
+
+@functools.lru_cache(maxsize=None)
+def build_kit(kind: str) -> ProblemKit:
+    """kind: "generic" (zero-preference, existing nodes, bulkable) or
+    "mixed" (relaxable + plain pods in one batch)."""
+    from karpenter_tpu.jaxsetup import ensure_compilation_cache
+
+    ensure_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.solver.tpu import _bulk_class_flags, _bulk_gates
+    from karpenter_tpu.solver.tpu_problem import encode_problem
+
+    sched, pods = _make_sched(kind)
+    problem = encode_problem(sched.oracle, pods)
+    tb = sched._tables(problem)
+    sched._upload_pod_tables(problem)
+    st = sched._init_state(problem, 8)
+    order = sched._order_pods(problem)
+    gates_ok = _bulk_gates(problem, strict_types=False)
+    sched._bulk_flags_c = _bulk_class_flags(problem, gates_ok)
+    sched._runflags_dev = (
+        jnp.asarray(sched._bulk_flags_c),
+        jnp.asarray(sched._aff_c),
+    )
+    xs, idx_d, n_d = sched._pod_xs_with_idx(problem, order)
+    rx = sched._run_x(xs, idx_d, n_d)
+    x_row = jax.tree_util.tree_map(lambda a: a[0], xs)
+    return ProblemKit(
+        sched=sched,
+        problem=problem,
+        tb=tb,
+        st=st,
+        order=order,
+        xs=xs,
+        x_row=x_row,
+        idx_d=idx_d,
+        n_d=n_d,
+        rx=rx,
+        seq=jnp.zeros(8, jnp.int32),
+        next_seq=jnp.zeros((), jnp.int32),
+        relax=bool((problem.ntiers_r > 1).any()),
+    )
+
+
+# ---------------------------------------------------------------------------
+# entry points
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    """One traced kernel entry. `build` returns (fn, args) ready for
+    jax.make_jaxpr; `path` is the repo-relative module the finding cites."""
+
+    name: str
+    path: str
+    kit: str
+    build: Callable[[ProblemKit], tuple]
+
+
+def _ep_solve_scan(relax: bool) -> Callable[[ProblemKit], tuple]:
+    def build(kit: ProblemKit) -> tuple:
+        from karpenter_tpu.solver import tpu_kernel as K
+
+        return (
+            lambda tb, st, xs: K.solve_scan(tb, st, xs, relax=relax),
+            (kit.tb, kit.st, kit.xs),
+        )
+
+    return build
+
+
+def _ep_solve_runs(relax: bool) -> Callable[[ProblemKit], tuple]:
+    def build(kit: ProblemKit) -> tuple:
+        import jax.numpy as jnp
+
+        from karpenter_tpu.solver import tpu_runs as KR
+
+        return (
+            lambda tb, st, rx, seq, nseq, n: KR.solve_runs(
+                tb, st, rx, seq, nseq, n, relax=relax
+            ),
+            (
+                kit.tb,
+                kit.st,
+                kit.rx,
+                kit.seq,
+                kit.next_seq,
+                jnp.int32(len(kit.order)),
+            ),
+        )
+
+    return build
+
+
+def _ep_step_relax(kit: ProblemKit) -> tuple:
+    from karpenter_tpu.solver import tpu_kernel as K
+
+    return K._step_relax, (kit.tb, kit.st, kit.x_row)
+
+
+def _ep_sweep(kit: ProblemKit) -> tuple:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from karpenter_tpu.controllers.disruption import sweep as SW
+
+    p = kit.problem
+    B = 4  # lanes; shape-only — the trace never executes
+    sizes = jnp.asarray(p.prequests_c[:1].astype(np.int32))
+    counts = jnp.ones((B, 1), jnp.int32)
+    cand_idx = jnp.asarray(
+        np.arange(p.num_existing, dtype=np.int32) % B
+    )
+    return (
+        functools.partial(SW._fast_sweep_kernel, singleton=False),
+        (
+            kit.tb,
+            kit.st,
+            kit.x_row,
+            jnp.asarray(p.eavail),
+            cand_idx,
+            counts,
+            sizes,
+        ),
+    )
+
+
+def _ep_typeok(kit: ProblemKit) -> tuple:
+    import jax.numpy as jnp
+
+    from karpenter_tpu.ops.encode import Reqs
+    from karpenter_tpu.solver.tpu import _typeok_chunk_impl
+
+    p = kit.problem
+    chunk = Reqs(*(jnp.asarray(a[p.rclass_creps]) for a in p.preq_c))
+    iw = max(1, (p.num_types + 31) // 32)
+    return (
+        functools.partial(_typeok_chunk_impl, iw=iw),
+        (kit.tb.ireq, kit.tb.va, chunk),
+    )
+
+
+def _ep_gather_xs(kit: ProblemKit) -> tuple:
+    from karpenter_tpu.solver import tpu as T
+
+    return (
+        lambda tables, idx, n: T._gather_xs(tables, idx, n),
+        (kit.sched._dev_tables, kit.idx_d, kit.n_d),
+    )
+
+
+_KERNEL_PATH = "karpenter_tpu/solver/tpu_kernel.py"
+_RUNS_PATH = "karpenter_tpu/solver/tpu_runs.py"
+_TPU_PATH = "karpenter_tpu/solver/tpu.py"
+_SWEEP_PATH = "karpenter_tpu/controllers/disruption/sweep.py"
+
+ENTRY_POINTS: tuple[EntryPoint, ...] = (
+    EntryPoint(
+        "solve_scan[relax=False]", _KERNEL_PATH, "generic",
+        _ep_solve_scan(False),
+    ),
+    EntryPoint(
+        "solve_scan[relax=True]", _KERNEL_PATH, "mixed", _ep_solve_scan(True)
+    ),
+    EntryPoint(
+        "solve_runs[relax=False]", _RUNS_PATH, "generic",
+        _ep_solve_runs(False),
+    ),
+    EntryPoint(
+        "solve_runs[relax=True]", _RUNS_PATH, "mixed", _ep_solve_runs(True)
+    ),
+    EntryPoint("_step_relax", _KERNEL_PATH, "mixed", _ep_step_relax),
+    EntryPoint("_fast_sweep_kernel", _SWEEP_PATH, "generic", _ep_sweep),
+    EntryPoint("_typeok_chunk", _TPU_PATH, "generic", _ep_typeok),
+    EntryPoint("_gather_xs", _TPU_PATH, "generic", _ep_gather_xs),
+)
+
+# the trace-time-static contract pairs: relax=True must contain EXACTLY
+# one more while loop (the tier ladder) than its relax=False twin —
+# equal counts mean the plain path compiled tier machinery; +2 or more
+# means the step got duplicated (the historical cond(plain, tiers) bug)
+STRUCTURE_PAIRS: tuple[tuple[str, str, str], ...] = (
+    ("solve_scan[relax=False]", "solve_scan[relax=True]", _KERNEL_PATH),
+    ("solve_runs[relax=False]", "solve_runs[relax=True]", _RUNS_PATH),
+)
+
+
+def trace_entry(ep: EntryPoint) -> Any:
+    """ClosedJaxpr of one entry point on its representative problem."""
+    import jax
+
+    kit = build_kit(ep.kit)
+    fn, args = ep.build(kit)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def structure_findings(
+    measured: dict[str, dict[str, int]]
+) -> list[Finding]:
+    out = []
+    for plain, relaxed, path in STRUCTURE_PAIRS:
+        if plain not in measured or relaxed not in measured:
+            continue
+        wp = measured[plain]["while_loops"]
+        wr = measured[relaxed]["while_loops"]
+        if wr != wp + 1:
+            out.append(
+                Finding(
+                    rule="ir-retrace",
+                    path=path,
+                    line=1,
+                    message=(
+                        f"{relaxed} has {wr} while loops vs {wp} in "
+                        f"{plain} — the relax ladder must add exactly one "
+                        "(equal: plain path compiled tier machinery; +2: "
+                        "the step instance got duplicated)"
+                    ),
+                    text=relaxed,
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# runtime accounting (retrace + transfer): two REAL solves of the generic
+# problem with fresh schedulers. The second has identical shapes, so the
+# trace-time-static contract demands zero new traces and zero compiles.
+
+
+def _runtime_solve() -> Any:
+    sched, pods = _make_sched("generic")
+    return sched.solve(pods)
+
+
+def runtime_metrics() -> dict[str, int]:
+    """The budgeted runtime measurements (entry `solve[runtime]`)."""
+    from karpenter_tpu.solver.tpu import TpuScheduler
+
+    counted = ("_tables", "_upload_pod_tables", "_pod_xs_with_idx")
+    with trace_events() as ev1, count_method_calls(
+        TpuScheduler, counted
+    ) as calls:
+        _runtime_solve()
+        first_traces = ev1.traces
+    with trace_events() as ev2:
+        _runtime_solve()
+    return {
+        "table_uploads": calls["_tables"],
+        "pod_table_uploads": calls["_upload_pod_tables"],
+        "pod_batch_uploads": calls["_pod_xs_with_idx"],
+        "first_solve_traces": first_traces,
+        "second_solve_traces": ev2.traces,
+        "second_solve_compiles": ev2.compiles,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the runner
+
+
+def _active(rule_ids: Optional[set]) -> set:
+    return set(IR_RULES) if rule_ids is None else set(rule_ids) & set(IR_RULES)
+
+
+def measure(
+    rule_ids: Optional[set] = None,
+) -> tuple[dict[str, dict[str, int]], list[Finding], list[str]]:
+    """Trace every entry point (and, when the retrace/transfer rules are
+    active, run the runtime accounting) on the representative problems.
+    Returns (measured metrics by entry, direct findings, errors)."""
+    active = _active(rule_ids)
+    measured: dict[str, dict[str, int]] = {}
+    findings: list[Finding] = []
+    errors: list[str] = []
+    need_traces = active & {
+        "ir-callbacks", "ir-dtype", "ir-carry-budget", "ir-retrace",
+    }
+    if need_traces:
+        for ep in ENTRY_POINTS:
+            try:
+                jaxpr = trace_entry(ep)
+            except Exception as e:  # a kernel that no longer traces is a
+                # broken gate, not a silent skip
+                errors.append(f"{ep.name}: {type(e).__name__}: {e}")
+                continue
+            measured[ep.name] = kernel_metrics(jaxpr)
+            if "ir-callbacks" in active:
+                for prim in forbidden_primitives(jaxpr):
+                    findings.append(
+                        Finding(
+                            rule="ir-callbacks",
+                            path=ep.path,
+                            line=1,
+                            message=(
+                                f"{ep.name}: forbidden host primitive "
+                                f"`{prim}` in the compiled program"
+                            ),
+                            text=ep.name,
+                        )
+                    )
+            if "ir-dtype" in active:
+                for dt in wide_dtypes(jaxpr):
+                    findings.append(
+                        Finding(
+                            rule="ir-dtype",
+                            path=ep.path,
+                            line=1,
+                            message=(
+                                f"{ep.name}: 64-bit aval `{dt}` on device "
+                                "(int64 guards belong on the host)"
+                            ),
+                            text=ep.name,
+                        )
+                    )
+                weak = sum(
+                    s.weak_carries for s in loop_stats(jaxpr)
+                )
+                if weak:
+                    findings.append(
+                        Finding(
+                            rule="ir-dtype",
+                            path=ep.path,
+                            line=1,
+                            message=(
+                                f"{ep.name}: {weak} weakly-typed loop "
+                                "carry aval(s) — pin the dtype"
+                            ),
+                            text=ep.name,
+                        )
+                    )
+        if "ir-retrace" in active:
+            findings.extend(structure_findings(measured))
+    if active & {"ir-retrace", "ir-transfer"}:
+        try:
+            measured["solve[runtime]"] = runtime_metrics()
+        except Exception as e:
+            errors.append(f"solve[runtime]: {type(e).__name__}: {e}")
+    return measured, findings, errors
+
+
+def budget_findings(
+    measured: dict[str, dict[str, int]],
+    manifest: budgets_mod.BudgetManifest,
+    rule_ids: Optional[set] = None,
+    errored: Optional[set] = None,
+) -> tuple[list[Finding], list[str]]:
+    """Compare measurements against the manifest; returns (findings,
+    improvement notes). Issues surface under the rule owning the metric
+    (ir-transfer / ir-retrace for runtime metrics, ir-carry-budget for
+    structure/carry and entry-level issues). `errored` names entries
+    whose trace FAILED — their budget entries must not read as orphaned
+    (the breakage is reported as an error, exit 2, not as 'remove the
+    budget entry')."""
+    active = _active(rule_ids)
+    cmp = manifest.compare(measured)
+    path = _entry_paths()
+    findings = []
+    for issue in cmp.issues:
+        if issue.kind == "orphaned-entry" and (
+            rule_ids is not None or issue.entry in (errored or ())
+        ):
+            # a partial run measures a slice of the entry points, and a
+            # trace failure leaves its entry unmeasured; neither makes
+            # the budget entry rot — only a full, error-free absence does
+            continue
+        rule = _METRIC_RULE.get(issue.metric or "", "ir-carry-budget")
+        if rule not in active:
+            continue
+        findings.append(
+            Finding(
+                rule=rule,
+                path=path.get(issue.entry, _TPU_PATH),
+                line=1,
+                message=issue.render(),
+                text=issue.entry,
+            )
+        )
+    notes = [i.render() for i in cmp.improvements]
+    return findings, notes
+
+
+def _entry_paths() -> dict[str, str]:
+    paths = {ep.name: ep.path for ep in ENTRY_POINTS}
+    paths["solve[runtime]"] = _TPU_PATH
+    return paths
+
+
+def run_ir_analysis(
+    repo_root: str,
+    budgets_path: Optional[str] = None,
+    baseline_path: Optional[str] = None,
+    rule_ids: Optional[set] = None,
+) -> dict:
+    """The IR pipeline: trace, account, compare to kernel_budgets.json,
+    apply the IR baseline. Mirrors engine.run_analysis's report shape:
+    {"findings": fresh, "all_findings", "stale", "unjustified",
+     "budget_unjustified", "improvements", "errors", "measured"}."""
+    import os
+
+    from karpenter_tpu.analysis.engine import Baseline
+
+    budgets_path = budgets_path or os.path.join(
+        repo_root, budgets_mod.DEFAULT_MANIFEST
+    )
+    baseline_path = (
+        baseline_path
+        if baseline_path is not None
+        else os.path.join(repo_root, "graftlint.ir.baseline.json")
+    )
+    manifest = budgets_mod.BudgetManifest.load(budgets_path)
+    measured, findings, errors = measure(rule_ids)
+    errored = {e.split(":", 1)[0] for e in errors}
+    bfindings, improvements = budget_findings(
+        measured, manifest, rule_ids, errored=errored
+    )
+    findings = sorted(
+        findings + bfindings, key=lambda f: (f.path, f.rule, f.text)
+    )
+    baseline = Baseline.load(baseline_path)
+    fresh, stale = baseline.apply(findings)
+    budget_unjustified = (
+        manifest.unjustified()
+        if _active(rule_ids)
+        >= {"ir-carry-budget", "ir-retrace", "ir-transfer"}
+        else []
+    )
+    return {
+        "findings": fresh,
+        "all_findings": findings,
+        "stale": stale,
+        "unjustified": baseline.unjustified(),
+        "budget_unjustified": budget_unjustified,
+        "improvements": improvements,
+        "errors": errors,
+        "measured": measured,
+        "manifest": manifest,
+    }

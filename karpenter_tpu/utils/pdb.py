@@ -5,7 +5,7 @@ disruption entirely."""
 from __future__ import annotations
 
 import math
-from typing import Optional
+from typing import Any, Optional
 
 from karpenter_tpu.api.objects import Pod, PodDisruptionBudget, PodPhase
 
@@ -53,7 +53,7 @@ class PDBLimits:
             self._matching[pdb.name] = matching
 
     @classmethod
-    def from_kube(cls, kube) -> "PDBLimits":
+    def from_kube(cls, kube: Any) -> "PDBLimits":
         return cls(kube.list("PodDisruptionBudget"), kube.list("Pod"))
 
     def _pdbs_for(self, pod: Pod) -> list[PodDisruptionBudget]:

@@ -13,7 +13,7 @@ from typing import TYPE_CHECKING, Iterable, Mapping
 from karpenter_tpu.utils import quantity
 
 if TYPE_CHECKING:  # pragma: no cover
-    from karpenter_tpu.api.objects import Pod
+    from karpenter_tpu.api.objects import Container, Pod
 
 ResourceList = dict[str, int]
 
@@ -84,8 +84,8 @@ def fits(candidate: Mapping[str, int], total: Mapping[str, int]) -> bool:
 
 
 def ceiling(
-    containers: Iterable = (),
-    init_containers: Iterable = (),
+    containers: Iterable["Container"] = (),
+    init_containers: Iterable["Container"] = (),
     overhead: Mapping[str, int] | None = None,
 ) -> ResourceList:
     """Effective pod requests from container-level specs (reference

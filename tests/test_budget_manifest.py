@@ -1,0 +1,151 @@
+"""kernel_budgets.json manifest mechanics (analysis/budgets.py).
+
+Pure stdlib — budgets.py must stay importable without JAX so these run
+in milliseconds. The live measurements side of the manifest is exercised
+by tests/test_ir_analysis.py; here the contract is the FILE: canonical
+byte-stable serialization (a `--write-budgets` re-write with unchanged
+content is byte-identical), justification policing, and stale/orphan
+detection mirroring graftlint.baseline.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import string
+
+from karpenter_tpu.analysis import budgets as B
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_checked_in_manifest_is_canonical_and_justified():
+    path = os.path.join(REPO_ROOT, B.DEFAULT_MANIFEST)
+    m = B.BudgetManifest.load(path)
+    assert m.entries, "the checked-in manifest must not be empty"
+    with open(path, encoding="utf-8") as f:
+        content = f.read()
+    assert B.BudgetManifest.dumps({"entries": m.entries}) == content, (
+        "kernel_budgets.json is not in canonical form — regenerate with "
+        "`graftlint --ir --write-budgets` (it preserves justifications)"
+    )
+    assert m.unjustified() == []
+    # every budgeted metric is one the tool knows how to enforce
+    for name, e in m.entries.items():
+        for metric in e.get("metrics", {}):
+            assert metric in B.METRIC_POLICY, (name, metric)
+
+
+def test_write_budgets_roundtrip_property():
+    """Property: render -> dumps -> load -> render -> dumps is a fixed
+    point (byte-identical), and a manifest compared against its own
+    measurements is clean — across randomized entry/metric subsets."""
+    rng = random.Random(0xBEEF)
+    metric_names = sorted(B.METRIC_POLICY)
+    for _ in range(25):
+        measured = {}
+        for i in range(rng.randint(1, 6)):
+            name = (
+                "".join(rng.choice(string.ascii_lowercase) for _ in range(8))
+                + f"[case={i}]"
+            )
+            picks = rng.sample(
+                metric_names, rng.randint(1, len(metric_names))
+            )
+            measured[name] = {m: rng.randint(0, 1 << 20) for m in picks}
+        data = B.BudgetManifest.render(measured)
+        s1 = B.BudgetManifest.dumps(data)
+        loaded = B.BudgetManifest(json.loads(s1)["entries"])
+        measured2 = {
+            k: dict(e["metrics"]) for k, e in loaded.entries.items()
+        }
+        s2 = B.BudgetManifest.dumps(
+            B.BudgetManifest.render(measured2, loaded)
+        )
+        assert s2 == s1
+        cmp = loaded.compare(measured2)
+        assert cmp.issues == []
+        assert cmp.improvements == []
+
+
+def test_render_preserves_existing_justifications():
+    existing = B.BudgetManifest(
+        {
+            "kept": {
+                "justification": "hand-written reason",
+                "metrics": {"while_loops": 1},
+            }
+        }
+    )
+    data = B.BudgetManifest.render(
+        {"kept": {"while_loops": 2}, "new": {"scans": 0}}, existing
+    )
+    assert data["entries"]["kept"]["justification"] == "hand-written reason"
+    assert data["entries"]["new"]["justification"].startswith("TODO")
+
+
+def test_orphaned_and_missing_entries_policed():
+    m = B.BudgetManifest(
+        {"gone_kernel": {"justification": "x", "metrics": {"scans": 1}}}
+    )
+    cmp = m.compare({"new_kernel": {"scans": 1}})
+    kinds = sorted(i.kind for i in cmp.issues)
+    assert kinds == ["missing-entry", "orphaned-entry"]
+
+
+def test_exact_policy_flags_any_drift():
+    m = B.BudgetManifest(
+        {"k": {"justification": "x", "metrics": {"while_loops": 2}}}
+    )
+    for measured_loops in (1, 3):
+        cmp = m.compare({"k": {"while_loops": measured_loops}})
+        assert [i.kind for i in cmp.issues] == ["structure-mismatch"]
+    assert m.compare({"k": {"while_loops": 2}}).issues == []
+
+
+def test_ceiling_policy_flags_only_growth():
+    m = B.BudgetManifest(
+        {"k": {"justification": "x", "metrics": {"max_carry_bytes": 100}}}
+    )
+    over = m.compare({"k": {"max_carry_bytes": 101}})
+    assert [i.kind for i in over.issues] == ["regression"]
+    under = m.compare({"k": {"max_carry_bytes": 99}})
+    assert under.issues == [] and len(under.improvements) == 1
+    note = under.improvements[0]
+    assert note.kind == "improvement"
+    # the note must state the actual relation (under, not exceeding)
+    assert "under the budget" in note.render()
+    assert "exceeds" not in note.render()
+
+
+def test_unknown_and_stale_metrics_policed():
+    # manifest carries a metric the tool doesn't know -> unknown-metric;
+    # tool measures a metric the manifest lacks -> missing-metric
+    m = B.BudgetManifest(
+        {
+            "k": {
+                "justification": "x",
+                "metrics": {"scans": 1, "typo_metric": 5},
+            }
+        }
+    )
+    cmp = m.compare({"k": {"scans": 1, "while_loops": 0}})
+    kinds = sorted(i.kind for i in cmp.issues)
+    assert kinds == ["missing-metric", "unknown-metric"]
+
+
+def test_issue_render_strings_are_actionable():
+    issues = [
+        B.BudgetIssue("regression", "k", "max_carry_bytes", 10, 20),
+        B.BudgetIssue("structure-mismatch", "k", "while_loops", 1, 2),
+        B.BudgetIssue("missing-entry", "k", None, None, None),
+        B.BudgetIssue("orphaned-entry", "k", None, None, None),
+        B.BudgetIssue("missing-metric", "k", "scans", None, 1),
+        B.BudgetIssue("unknown-metric", "k", "zzz", 1, None),
+        B.BudgetIssue("improvement", "k", "max_carry_bytes", 10, 5),
+    ]
+    for issue in issues:
+        text = issue.render()
+        assert "k" in text and text  # every kind renders something useful
+    assert "--write-budgets" in issues[0].render()

@@ -92,6 +92,48 @@ def test_cold_process_solve_rides_warm_cache(tmp_path):
     assert r2["solve_seconds"] < max(10.0, 0.5 * r1["solve_seconds"]), (r1, r2)
 
 
+def test_second_solve_same_shape_zero_retraces_in_process():
+    """The in-process half of the compile-budget story (the subprocess
+    test above covers the cross-process persistent cache): a second solve
+    of an identical-shape problem must reuse every compiled program — no
+    new jaxpr traces, no backend compiles. Counted with the same
+    jax.monitoring event counter the graftlint IR tier's retrace rule
+    uses (analysis/ir.py trace_events), so the pytest gate and
+    `graftlint --ir` cannot drift apart on what "a retrace" means."""
+    from karpenter_tpu.analysis.ir import trace_events
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import TpuScheduler
+    from karpenter_tpu.testing import fixtures
+
+    def solve():
+        fixtures.reset_rng(11)
+        its = construct_instance_types(sizes=[2])
+        pool = fixtures.node_pool(name="default")
+        pods = fixtures.make_generic_pods(8)
+        topo = Topology([pool], {"default": its}, pods)
+        sched = TpuScheduler([pool], {"default": its}, topo)
+        return sched.solve(pods), pods
+
+    r1, pods1 = solve()
+    with trace_events() as ev:
+        r2, pods2 = solve()
+    assert ev.traces == 0, (
+        f"second same-shape solve traced {ev.traces} new programs"
+    )
+    assert ev.compiles == 0
+    # and it is the same solve: identical pod partition
+
+    def parts(r, pods):
+        names = {p.uid: p.name for p in pods}
+        return sorted(
+            tuple(sorted(names[p.uid] for p in c.pods))
+            for c in r.new_node_claims
+        )
+
+    assert parts(r2, pods2) == parts(r1, pods1)
+
+
 def test_cache_disabled_by_empty_env(tmp_path, monkeypatch):
     import importlib
 

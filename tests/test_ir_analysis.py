@@ -1,0 +1,564 @@
+"""graftlint IR tier gate (analysis/ir.py): per-rule positive/negative
+fixtures, the budget-manifest mechanics against live measurements, and
+the full-tree run — every solver entry point traces clean and matches
+kernel_budgets.json.
+
+The module-scoped `report` fixture does the expensive work once: traces
+the eight kernel entry points and runs the two runtime-accounting solves
+on JAX_PLATFORMS=cpu. Everything else is doctored-input unit tests on
+the walkers and the manifest comparison.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from karpenter_tpu.analysis import budgets as budgets_mod
+from karpenter_tpu.analysis import ir
+from karpenter_tpu.analysis.__main__ import main as graftlint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def report():
+    return ir.run_ir_analysis(REPO_ROOT)
+
+
+@pytest.fixture(scope="module")
+def manifest_entries(report):
+    """Deep-copyable real manifest entries for doctoring."""
+    return {
+        name: copy.deepcopy(e) for name, e in report["manifest"].entries.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# full-tree cleanliness (the gate)
+
+
+def test_full_tree_clean(report):
+    assert report["errors"] == []
+    assert [f.render() for f in report["findings"]] == []
+    assert report["stale"] == []
+    assert report["unjustified"] == []
+    assert report["budget_unjustified"] == []
+
+
+def test_manifest_covers_every_entry_point(report):
+    names = {ep.name for ep in ir.ENTRY_POINTS} | {"solve[runtime]"}
+    assert set(report["measured"]) == names
+    assert set(report["manifest"].entries) == names
+
+
+def test_runtime_contracts_hold(report):
+    rt = report["measured"]["solve[runtime]"]
+    # the absolute contracts, independent of what the manifest says:
+    # per-class tables ship once per solve, and a repeated same-shape
+    # solve retraces and recompiles nothing
+    assert rt["table_uploads"] == 1
+    assert rt["pod_table_uploads"] == 1
+    assert rt["second_solve_traces"] == 0
+    assert rt["second_solve_compiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ir-callbacks
+
+
+def test_callbacks_flags_debug_callback():
+    def f(x):
+        jax.debug.print("x={x}", x=x)
+        return x + 1
+
+    found = ir.forbidden_primitives(jax.make_jaxpr(f)(jnp.ones(3)))
+    assert found and all("callback" in p for p in found)
+
+
+def test_callbacks_flags_pure_callback():
+    import numpy as np
+
+    def f(x):
+        return jax.pure_callback(
+            lambda a: np.asarray(a),
+            jax.ShapeDtypeStruct((3,), jnp.float32),
+            x,
+        )
+
+    assert ir.forbidden_primitives(jax.make_jaxpr(f)(jnp.ones(3))) == [
+        "pure_callback"
+    ]
+
+
+def test_callbacks_clean_program_negative():
+    j = jax.make_jaxpr(lambda x: (x * 2).sum())(jnp.ones(3))
+    assert ir.forbidden_primitives(j) == []
+
+
+def test_callbacks_seen_through_jit_and_scan():
+    """The walker must recurse into pjit/scan sub-jaxprs — a callback
+    hidden inside nested control flow still surfaces."""
+
+    @jax.jit
+    def inner(c, x):
+        jax.debug.print("c={c}", c=c)
+        return c + x, x
+
+    def f(xs):
+        return jax.lax.scan(inner, jnp.float32(0), xs)
+
+    assert ir.forbidden_primitives(jax.make_jaxpr(f)(jnp.ones(4)))
+
+
+# ---------------------------------------------------------------------------
+# ir-dtype
+
+
+def test_dtype_flags_64bit_avals():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        j = jax.make_jaxpr(lambda x: x.astype("int64") + 1)(
+            jnp.arange(3, dtype=jnp.int32)
+        )
+    assert "int64" in ir.wide_dtypes(j)
+
+
+def test_dtype_negative_int32_program():
+    j = jax.make_jaxpr(lambda x: x * jnp.int32(2))(
+        jnp.arange(3, dtype=jnp.int32)
+    )
+    assert ir.wide_dtypes(j) == []
+
+
+def test_dtype_flags_weak_carry():
+    def f(x):
+        return jax.lax.while_loop(
+            lambda c: c[0] < 3, lambda c: (c[0] + 1, c[1] * 2.0), (0, x)
+        )
+
+    stats = ir.loop_stats(jax.make_jaxpr(f)(jnp.float32(1.0)))
+    assert sum(s.weak_carries for s in stats) > 0
+
+
+def test_dtype_negative_pinned_carry():
+    def f(x):
+        return jax.lax.while_loop(
+            lambda c: c[0] < jnp.int32(3),
+            lambda c: (c[0] + jnp.int32(1), c[1] * jnp.float32(2)),
+            (jnp.int32(0), x),
+        )
+
+    stats = ir.loop_stats(jax.make_jaxpr(f)(jnp.float32(1.0)))
+    assert stats and sum(s.weak_carries for s in stats) == 0
+
+
+# ---------------------------------------------------------------------------
+# loop-carry measurement
+
+
+def test_loop_stats_scan_carry_bytes():
+    def f(xs):
+        def body(c, x):
+            return (c[0] + x, c[1] + jnp.int32(1)), x
+
+        return jax.lax.scan(
+            body, (jnp.zeros(4, jnp.float32), jnp.int32(0)), xs
+        )
+
+    stats = ir.loop_stats(jax.make_jaxpr(f)(jnp.ones(5)))
+    scans = [s for s in stats if s.kind == "scan"]
+    assert len(scans) == 1
+    assert scans[0].length == 5
+    assert scans[0].carry_bytes == 4 * 4 + 4  # f32[4] + i32 scalar
+
+
+def test_kernel_metrics_shape():
+    def f(xs):
+        return jax.lax.scan(lambda c, x: (c + x, x), jnp.float32(0), xs)
+
+    m = ir.kernel_metrics(jax.make_jaxpr(f)(jnp.ones(3)))
+    assert m == {
+        "while_loops": 0,
+        "scans": 1,
+        "max_carry_bytes": 4,
+        "total_carry_bytes": 4,
+        "scan_total_length": 3,
+    }
+    assert set(m) <= set(budgets_mod.METRIC_POLICY)
+
+
+# ---------------------------------------------------------------------------
+# ir-carry-budget (doctored manifests against live measurements)
+
+
+def _findings_for(measured, entries, rule_ids=None):
+    manifest = budgets_mod.BudgetManifest(entries)
+    findings, notes = ir.budget_findings(measured, manifest, rule_ids)
+    return findings, notes
+
+
+def test_budget_regression_detected(report, manifest_entries):
+    entries = copy.deepcopy(manifest_entries)
+    got = report["measured"]["solve_scan[relax=False]"]["max_carry_bytes"]
+    entries["solve_scan[relax=False]"]["metrics"]["max_carry_bytes"] = got - 1
+    findings, _ = _findings_for(report["measured"], entries)
+    assert any(
+        f.rule == "ir-carry-budget" and "regressed" in f.message
+        and f.text == "solve_scan[relax=False]"
+        for f in findings
+    )
+
+
+def test_budget_structure_mismatch_detected(report, manifest_entries):
+    entries = copy.deepcopy(manifest_entries)
+    entries["solve_scan[relax=True]"]["metrics"]["while_loops"] += 1
+    findings, _ = _findings_for(report["measured"], entries)
+    assert any(
+        f.rule == "ir-carry-budget" and "exact-match" in f.message
+        for f in findings
+    )
+
+
+def test_budget_ceiling_slack_is_not_a_finding(report, manifest_entries):
+    entries = copy.deepcopy(manifest_entries)
+    entries["solve_scan[relax=False]"]["metrics"]["max_carry_bytes"] += 1000
+    findings, notes = _findings_for(report["measured"], entries)
+    assert not any(f.text == "solve_scan[relax=False]" for f in findings)
+    assert any("max_carry_bytes" in n for n in notes)
+
+
+def test_budget_orphan_and_missing_policed(report, manifest_entries):
+    entries = copy.deepcopy(manifest_entries)
+    entries["ghost_kernel"] = {
+        "justification": "x", "metrics": {"while_loops": 0},
+    }
+    del entries["_gather_xs"]
+    findings, _ = _findings_for(report["measured"], entries)
+    msgs = [f.message for f in findings]
+    assert any("matches no traced entry point" in m for m in msgs)
+    assert any("no budget entry" in m for m in msgs)
+
+
+def test_budget_unknown_metric_policed(report, manifest_entries):
+    entries = copy.deepcopy(manifest_entries)
+    entries["_gather_xs"]["metrics"]["made_up_metric"] = 7
+    findings, _ = _findings_for(report["measured"], entries)
+    assert any("unknown metric" in f.message for f in findings)
+
+
+def test_budget_unjustified_policed():
+    m = budgets_mod.BudgetManifest(
+        {
+            "a": {"justification": "TODO: justify or fix", "metrics": {}},
+            "b": {"justification": "  ", "metrics": {}},
+            "c": {"justification": "real reason", "metrics": {}},
+        }
+    )
+    assert m.unjustified() == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# ir-retrace
+
+
+def test_structure_findings_flag_duplicated_step():
+    measured = {
+        "solve_scan[relax=False]": {"while_loops": 1},
+        "solve_scan[relax=True]": {"while_loops": 3},  # step duplicated
+    }
+    fs = ir.structure_findings(measured)
+    assert len(fs) == 1 and fs[0].rule == "ir-retrace"
+
+
+def test_structure_findings_flag_tier_machinery_in_plain_path():
+    measured = {
+        "solve_runs[relax=False]": {"while_loops": 3},  # == relaxed: leak
+        "solve_runs[relax=True]": {"while_loops": 3},
+    }
+    assert len(ir.structure_findings(measured)) == 1
+
+
+def test_structure_findings_negative(report):
+    assert ir.structure_findings(report["measured"]) == []
+
+
+def test_trace_events_zero_on_cache_hit():
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    f(jnp.ones(3))
+    with ir.trace_events() as ev:
+        f(jnp.ones(3))
+    assert ev.traces == 0 and ev.compiles == 0
+
+
+def test_trace_events_count_new_shape():
+    @jax.jit
+    def g(x):
+        return x * 2
+
+    g(jnp.ones(3))
+    with ir.trace_events() as ev:
+        g(jnp.ones(7))  # new shape -> retrace
+    assert ev.traces >= 1
+
+
+def test_retrace_budget_violation_surfaces(report, manifest_entries):
+    entries = copy.deepcopy(manifest_entries)
+    measured = copy.deepcopy(report["measured"])
+    measured["solve[runtime]"]["second_solve_traces"] = 4
+    findings, _ = _findings_for(measured, entries)
+    assert any(
+        f.rule == "ir-retrace" and "second_solve_traces" in f.message
+        for f in findings
+    )
+
+
+# ---------------------------------------------------------------------------
+# ir-transfer
+
+
+def test_count_method_calls_counts_and_restores():
+    class C:
+        def m(self):
+            return 42
+
+    orig = C.m
+    with ir.count_method_calls(C, ("m",)) as counts:
+        assert C().m() == 42
+        assert C().m() == 42
+    assert counts["m"] == 2
+    assert C.m is orig
+    C().m()
+    assert counts["m"] == 2  # counter detached after exit
+
+
+def test_transfer_budget_violation_surfaces(report, manifest_entries):
+    entries = copy.deepcopy(manifest_entries)
+    measured = copy.deepcopy(report["measured"])
+    measured["solve[runtime]"]["table_uploads"] = 2
+    findings, _ = _findings_for(measured, entries)
+    assert any(
+        f.rule == "ir-transfer" and "table_uploads" in f.message
+        for f in findings
+    )
+
+
+def test_partial_run_does_not_police_orphans(report, manifest_entries):
+    """A --rules subset measures a slice of the entry points; manifest
+    entries for out-of-scope kernels must not read as orphaned (only the
+    full run polices rot — the AST tier's subset-run convention)."""
+    entries = copy.deepcopy(manifest_entries)
+    measured = {
+        k: copy.deepcopy(v)
+        for k, v in report["measured"].items()
+        if k != "solve[runtime]"
+    }
+    findings, _ = _findings_for(
+        measured, entries, rule_ids={"ir-carry-budget"}
+    )
+    assert findings == []
+    # the full run still polices the same gap
+    findings_full, _ = _findings_for(measured, entries)
+    assert any("matches no traced entry point" in f.message for f in findings_full)
+
+
+def test_trace_failure_is_not_reported_as_orphan(report, manifest_entries):
+    """A kernel that fails to trace is a broken gate (error, exit 2) —
+    its still-valid budget entry must NOT surface as 'orphaned, remove
+    it', which would invite deleting the entry that masks the breakage."""
+    entries = copy.deepcopy(manifest_entries)
+    measured = {
+        k: copy.deepcopy(v)
+        for k, v in report["measured"].items()
+        if k != "_step_relax"  # simulate: its trace raised
+    }
+    findings, _ = _findings_for(measured, entries)
+    assert any("_step_relax" in f.message for f in findings)  # full run
+    findings_err, _ = ir.budget_findings(
+        measured,
+        budgets_mod.BudgetManifest(entries),
+        None,
+        errored={"_step_relax"},
+    )
+    assert not any("_step_relax" in f.message for f in findings_err)
+
+
+def test_cli_ir_trace_error_exits_2(monkeypatch, capsys):
+    """Exit-code contract: trace errors dominate comparison findings."""
+
+    def boom(rule_ids=None):
+        return {}, [], ["_step_relax: RuntimeError: kernel broke"]
+
+    monkeypatch.setattr(ir, "measure", boom)
+    rc = graftlint_main(["--ir", "--root", REPO_ROOT])
+    assert rc == 2
+    assert "trace error" in capsys.readouterr().out
+
+
+def test_rule_filter_scopes_budget_findings(report, manifest_entries):
+    entries = copy.deepcopy(manifest_entries)
+    measured = copy.deepcopy(report["measured"])
+    measured["solve[runtime]"]["table_uploads"] = 2
+    measured["solve_scan[relax=False]"]["while_loops"] = 5
+    findings, _ = _findings_for(measured, entries, rule_ids={"ir-transfer"})
+    assert findings and all(f.rule == "ir-transfer" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics (shared engine.Baseline, IR identity = entry name)
+
+
+def test_ir_findings_are_baselinable(report, manifest_entries):
+    from karpenter_tpu.analysis.engine import Baseline
+
+    entries = copy.deepcopy(manifest_entries)
+    measured = copy.deepcopy(report["measured"])
+    measured["solve[runtime]"]["table_uploads"] = 2
+    findings, _ = _findings_for(measured, entries)
+    target = [f for f in findings if f.rule == "ir-transfer"]
+    baseline = Baseline(
+        [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "text": f.text,
+                "justification": "known double-upload under test",
+            }
+            for f in target
+        ]
+    )
+    fresh, stale = baseline.apply(findings)
+    assert not any(f.rule == "ir-transfer" for f in fresh)
+    assert stale == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_ir_full_tree_clean(capsys):
+    assert graftlint_main(["--ir", "--root", REPO_ROOT]) == 0
+    out = capsys.readouterr().out
+    assert "0 findings" in out
+
+
+def test_cli_ir_rejects_paths_and_changed_only(capsys):
+    assert graftlint_main(["--ir", "--root", REPO_ROOT, "some.py"]) == 2
+    assert graftlint_main(["--ir", "--root", REPO_ROOT, "--changed-only"]) == 2
+
+
+def test_cli_ir_malformed_budgets_exits_2(tmp_path, capsys):
+    """A hand-edit typo in kernel_budgets.json (the documented
+    re-baseline workflow edits it) must surface as the exit-2 parse
+    diagnostic naming the file, not a JSONDecodeError traceback."""
+    bad = tmp_path / "kernel_budgets.json"
+    bad.write_text('{"entries": {,}}', encoding="utf-8")
+    rc = graftlint_main(
+        ["--ir", "--root", REPO_ROOT, "--budgets", str(bad)]
+    )
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "cannot parse" in err and str(bad) in err
+
+
+def test_cli_ir_rejects_unknown_rule_id(capsys):
+    """A typo'd --rules id must exit 2, not measure nothing and read as
+    a clean gate."""
+    rc = graftlint_main(
+        ["--ir", "--root", REPO_ROOT, "--rules", "ir-carrybudget"]
+    )
+    assert rc == 2
+    assert "unknown IR rule" in capsys.readouterr().err
+
+
+def test_cli_ir_write_baseline_rejects_rule_subset(tmp_path, capsys):
+    rc = graftlint_main(
+        [
+            "--ir",
+            "--root",
+            REPO_ROOT,
+            "--rules",
+            "ir-callbacks",
+            "--write-baseline",
+            "--baseline",
+            str(tmp_path / "bl.json"),
+        ]
+    )
+    assert rc == 2
+    assert not (tmp_path / "bl.json").exists()
+
+
+def test_cli_ir_write_baseline_refuses_on_trace_errors(
+    tmp_path, monkeypatch, capsys
+):
+    """A broken kernel trace must never rewrite the IR baseline as if the
+    errored kernel's findings were resolved."""
+
+    def boom(rule_ids=None):
+        return {}, [], ["_step_relax: RuntimeError: kernel broke"]
+
+    monkeypatch.setattr(ir, "measure", boom)
+    bl = tmp_path / "bl.json"
+    rc = graftlint_main(
+        [
+            "--ir",
+            "--root",
+            REPO_ROOT,
+            "--write-baseline",
+            "--baseline",
+            str(bl),
+        ]
+    )
+    assert rc == 2
+    assert not bl.exists()
+    assert "trace error" in capsys.readouterr().err
+
+
+def test_cli_write_budgets_rejects_rule_subset(tmp_path, capsys):
+    rc = graftlint_main(
+        [
+            "--ir",
+            "--write-budgets",
+            "--rules",
+            "ir-callbacks",
+            "--root",
+            REPO_ROOT,
+            "--budgets",
+            str(tmp_path / "b.json"),
+        ]
+    )
+    assert rc == 2
+    assert not (tmp_path / "b.json").exists()
+
+
+def test_cli_ir_budget_regression_exits_1(tmp_path, report, capsys):
+    """A doctored manifest (one ceiling below the measurement) must fail
+    the CLI gate — the seeded end-to-end positive for the budget rules."""
+    entries = {
+        name: copy.deepcopy(e)
+        for name, e in report["manifest"].entries.items()
+    }
+    got = report["measured"]["solve_scan[relax=False]"]["max_carry_bytes"]
+    entries["solve_scan[relax=False]"]["metrics"]["max_carry_bytes"] = got - 1
+    p = tmp_path / "kernel_budgets.json"
+    p.write_text(
+        budgets_mod.BudgetManifest.dumps({"entries": entries}),
+        encoding="utf-8",
+    )
+    rc = graftlint_main(
+        ["--ir", "--root", REPO_ROOT, "--budgets", str(p), "--json"]
+    )
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert any(
+        "max_carry_bytes" in f["message"] for f in data["findings"]
+    )

@@ -539,6 +539,76 @@ def test_baseline_matches_by_text_and_reports_stale():
     assert bl.unjustified() == []
 
 
+def test_cli_rule_subset_does_not_report_stale(capsys):
+    """A --rules subset generates only that rule's findings; baseline
+    entries for other rules must not read as stale (they'd otherwise be
+    reported with 'remove it' advice on every documented per-rule run)."""
+    from karpenter_tpu.analysis.__main__ import main as graftlint_main
+
+    rc = graftlint_main(["--root", REPO_ROOT, "--rules", "pytest-markers"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "stale" not in out
+
+
+def test_cli_unknown_rule_id_exits_2(capsys):
+    """A typo'd --rules id must not read as 'nothing to check, clean'."""
+    from karpenter_tpu.analysis.__main__ import main as graftlint_main
+
+    rc = graftlint_main(["--root", REPO_ROOT, "--rules", "milli-unitz"])
+    assert rc == 2
+    assert "unknown rule id" in capsys.readouterr().err
+
+
+def test_cli_write_baseline_rejects_rule_subset(tmp_path, capsys):
+    """--write-baseline from a rule subset would truncate every
+    out-of-scope curated entry — same guard as explicit paths."""
+    from karpenter_tpu.analysis.__main__ import main as graftlint_main
+
+    bl = tmp_path / "bl.json"
+    rc = graftlint_main(
+        [
+            "--root",
+            REPO_ROOT,
+            "--rules",
+            "milli-units",
+            "--write-baseline",
+            "--baseline",
+            str(bl),
+        ]
+    )
+    assert rc == 2
+    assert not bl.exists()
+
+
+def test_cli_malformed_baseline_exits_2(tmp_path, capsys):
+    """A hand-edit typo in the baseline file must surface as the exit-2
+    parse diagnostic naming the file, not a JSONDecodeError traceback."""
+    from karpenter_tpu.analysis.__main__ import main as graftlint_main
+
+    bad = tmp_path / "baseline.json"
+    bad.write_text('{"entries": [,]}', encoding="utf-8")
+    rc = graftlint_main(["--root", REPO_ROOT, "--baseline", str(bad)])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "cannot parse" in err and str(bad) in err
+
+
+def test_checked_in_baseline_is_canonical():
+    """graftlint.baseline.json must be in the canonical serialization
+    `--write-baseline` produces (engine.canonical_json) — otherwise the
+    first rewrite after a real change buries the meaningful diff hunk in
+    a whole-file key-order churn."""
+    import json
+
+    from karpenter_tpu.analysis.engine import canonical_json
+
+    path = os.path.join(REPO_ROOT, "graftlint.baseline.json")
+    with open(path, encoding="utf-8") as f:
+        content = f.read()
+    assert canonical_json(json.loads(content)) == content
+
+
 # ---------------------------------------------------------------------------
 # the real tree
 

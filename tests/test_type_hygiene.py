@@ -1,0 +1,57 @@
+"""Optional type-hygiene gate: mypy over `karpenter_tpu/analysis/` and
+`karpenter_tpu/utils/` (the [tool.mypy] config in pyproject.toml).
+
+These two packages are pure host-side python with stable, fully
+annotatable surfaces — the analyzer must stay import-light and the
+milli-unit helpers are the arithmetic the whole codebase trusts. The
+gate SKIPS cleanly when mypy isn't installed (the container doesn't bake
+it in; `pip install mypy` locally to activate it) — it must never turn
+tier-1 red on a missing dev tool.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_HAS_MYPY = importlib.util.find_spec("mypy") is not None
+
+
+@pytest.mark.skipif(not _HAS_MYPY, reason="mypy not installed")
+def test_mypy_clean_on_analysis_and_utils():
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "mypy",
+            "--config-file",
+            "pyproject.toml",
+            "karpenter_tpu/analysis",
+            "karpenter_tpu/utils",
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert res.returncode == 0, (
+        "mypy found type errors:\n" + res.stdout + res.stderr
+    )
+
+
+def test_mypy_config_present_for_when_it_lands():
+    """The config the gate runs under must exist even where mypy doesn't
+    — otherwise installing mypy later silently checks nothing."""
+    with open(
+        os.path.join(REPO_ROOT, "pyproject.toml"), encoding="utf-8"
+    ) as f:
+        text = f.read()
+    assert "[tool.mypy]" in text
+    assert "karpenter_tpu/analysis" in text
+    assert "karpenter_tpu/utils" in text
