@@ -1,4 +1,4 @@
-"""graftlint — the two-tier invariant analyzer for this codebase.
+"""graftlint — the three-tier invariant analyzer for this codebase.
 
 The AST tier mechanically enforces the source-level architecture
 contracts documented in CLAUDE.md and the gate comments atop
@@ -15,16 +15,28 @@ checked-in kernel_budgets.json (analysis/budgets.py), the
 trace-time-static relax contract, and per-solve upload/retrace
 accounting.
 
+The race tier checks the concurrency contracts of the solver service
+boundary in two halves: a static whole-program lock analysis
+(analysis/locks.py, `--race` — acquisition-graph cycles, blocking calls
+under locks, unguarded thread-shared writes) and a tsan-lite runtime
+witness (analysis/racert.py) that instruments threading's locks under
+the fault-injection pytest suite and fails on observed lock-order
+inversions.
+
 Importing THIS package MUST NOT import JAX or numpy
 (tests/test_static_analysis.py pins this) — the AST gate runs in seconds
 with no device/tunnel involvement; only analysis/ir.py imports JAX, and
-only when loaded explicitly (the CLI does so under `--ir`).
+only when loaded explicitly (the CLI does so under `--ir`). The race
+tier's both halves are stdlib-only too (tests/test_race_analysis.py
+pins that).
 
 Usage:
     python -m karpenter_tpu.analysis            # AST: lint package + tests
     python -m karpenter_tpu.analysis --json     # machine-readable
     python -m karpenter_tpu.analysis --changed-only   # pre-commit mode
     python -m karpenter_tpu.analysis --ir       # IR: trace kernels + budgets
+    python -m karpenter_tpu.analysis --race     # race tier, static half
+    python -m karpenter_tpu.analysis --all      # every tier, worst exit code
 
 Rules, suppression syntax (`# graftlint: disable=<rule>`), the baseline
 workflow, and the budget manifest are documented in

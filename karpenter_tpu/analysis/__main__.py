@@ -1,13 +1,20 @@
 """graftlint CLI: `python -m karpenter_tpu.analysis` (also installed as
 the `graftlint` console script).
 
-Two tiers share this entry point:
+Three tiers share this entry point:
 
 - the AST tier (default): stdlib-`ast` source analysis, JAX-free;
 - the IR tier (`--ir`): traces the real solver kernels and walks the
   jaxprs (analysis/ir.py) — imports JAX, needs JAX_PLATFORMS=cpu or a
   device, and enforces kernel_budgets.json (`--write-budgets` to
-  re-baseline after an intentional kernel change).
+  re-baseline after an intentional kernel change);
+- the race tier (`--race`): whole-program lock analysis (analysis/
+  locks.py) — acquisition-graph cycles, blocking calls under locks,
+  thread-vs-public unguarded writes. JAX-free like the AST tier; the
+  runtime half (analysis/racert.py) runs under pytest, not here.
+
+`--all` runs every tier (AST + race + IR) with merged `--json` output
+and a single worst-case exit code — the one-command CI gate.
 
 Exit codes: 0 clean (baseline-covered findings allowed), 1 findings or
 stale/unjustified baseline or budget entries, 2 usage/parse/trace errors.
@@ -22,11 +29,14 @@ import subprocess
 import sys
 
 from karpenter_tpu.analysis.engine import (
+    IR_DEFAULT_BASELINE,
     Baseline,
     all_rules,
     canonical_json,
     run_analysis,
 )
+
+_DEFAULT_REFERENCE_ROOT = "/root/reference"
 
 
 def _detect_repo_root() -> str:
@@ -70,6 +80,44 @@ def _write_baseline_file(baseline_path: str, findings) -> int:
         + (f" — justify the {fresh} new one(s)" if fresh else "")
     )
     return 0
+
+
+def _tier_payload(findings, stale, unjustified, errors, baselined) -> dict:
+    """The `--json` report shape every tier shares (IR adds its budget
+    keys on top). One builder, or the tiers' payloads drift apart."""
+    return {
+        "findings": [f.to_dict() for f in findings],
+        "stale_baseline": stale,
+        "unjustified_baseline": unjustified,
+        "errors": errors,
+        "baselined": baselined,
+    }
+
+
+def _print_baseline_problems(stale, unjustified, prefix: str = "") -> None:
+    """Itemize the stale/unjustified baseline entries behind an exit-1:
+    a red gate must name each entry to act on, in `--all` (which tags a
+    `[tier] ` prefix) exactly as in the single-tier modes."""
+    for e in stale:
+        print(
+            f"{prefix}stale baseline entry: [{e.get('rule')}] "
+            f"{e.get('path')}: {e.get('text')!r} no longer matches — "
+            "remove it"
+        )
+    for e in unjustified:
+        print(
+            f"{prefix}unjustified baseline entry: [{e.get('rule')}] "
+            f"{e.get('path')}: add a one-line justification"
+        )
+
+
+def _print_report_entries(findings, stale, unjustified) -> None:
+    """The text-mode finding/stale/unjustified lines every tier shares
+    (errors and the summary line stay per-tier: the error word and the
+    counts genuinely differ)."""
+    for f in findings:
+        print(f.render())
+    _print_baseline_problems(stale, unjustified)
 
 
 def _changed_files(repo_root: str):
@@ -117,7 +165,7 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--reference-root",
-        default="/root/reference",
+        default=_DEFAULT_REFERENCE_ROOT,
         help="reference checkout for .go citation resolution",
     )
     parser.add_argument(
@@ -144,6 +192,19 @@ def main(argv=None) -> int:
         "jaxprs (imports JAX; see docs/static-analysis.md)",
     )
     parser.add_argument(
+        "--race",
+        action="store_true",
+        help="run the race tier's static half: whole-program lock-order/"
+        "blocking-hold/unguarded-shared analysis (JAX-free; the runtime "
+        "witness runs under pytest — see docs/static-analysis.md)",
+    )
+    parser.add_argument(
+        "--all",
+        action="store_true",
+        help="run every tier (AST + race + IR) with merged --json output "
+        "and a single worst-case exit code",
+    )
+    parser.add_argument(
         "--budgets",
         default=None,
         help="IR budget manifest (default: <root>/kernel_budgets.json)",
@@ -160,16 +221,43 @@ def main(argv=None) -> int:
         for r in all_rules():
             print(f"{r.id:20s} {r.summary}")
         from karpenter_tpu.analysis.ir import IR_RULES
+        from karpenter_tpu.analysis.locks import RACE_RULES
 
         for rid, summary in IR_RULES.items():
             print(f"{rid:20s} [ir] {summary}")
+        for rid, summary in RACE_RULES.items():
+            print(f"{rid:20s} [race] {summary}")
         return 0
 
     repo_root = os.path.abspath(args.root or _detect_repo_root())
+    # tier modes are mutually exclusive; silent precedence would let
+    # `--ir --race` go green having never run the race tier, and
+    # `--race --write-budgets` rewrite kernel_budgets.json unasked
+    picked = [
+        flag
+        for flag, on in (
+            ("--all", args.all),
+            ("--ir", args.ir or args.write_budgets),
+            ("--race", args.race),
+        )
+        if on
+    ]
+    if len(picked) > 1:
+        print(
+            "graftlint: " + " and ".join(picked) + " are mutually "
+            "exclusive — pick one tier mode (--all runs every tier; "
+            "--write-budgets implies --ir)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.all:
+        return _main_all(args, repo_root)
     if args.write_budgets:
         args.ir = True
     if args.ir:
         return _main_ir(args, repo_root)
+    if args.race:
+        return _main_race(args, repo_root)
     paths = [os.path.abspath(p) for p in args.paths] or None
     if args.changed_only:
         paths = _changed_files(repo_root)
@@ -188,7 +276,8 @@ def main(argv=None) -> int:
             print(
                 "graftlint: unknown rule id(s): "
                 + ", ".join(sorted(unknown))
-                + " (see --list-rules; ir-* rules need --ir)",
+                + " (see --list-rules; ir-* rules need --ir, race-* "
+                "rules need --race)",
                 file=sys.stderr,
             )
             return 2
@@ -231,35 +320,18 @@ def main(argv=None) -> int:
     unjustified = report["unjustified"]
     errors = report["errors"]
 
+    baselined = report["total"] - len(findings)
     if args.json:
         print(
             json.dumps(
-                {
-                    "findings": [f.to_dict() for f in findings],
-                    "stale_baseline": stale,
-                    "unjustified_baseline": unjustified,
-                    "errors": errors,
-                    "baselined": report["total"] - len(findings),
-                },
+                _tier_payload(findings, stale, unjustified, errors, baselined),
                 indent=2,
             )
         )
     else:
-        for f in findings:
-            print(f.render())
-        for e in stale:
-            print(
-                f"stale baseline entry: [{e.get('rule')}] {e.get('path')}: "
-                f"{e.get('text')!r} no longer matches — remove it"
-            )
-        for e in unjustified:
-            print(
-                f"unjustified baseline entry: [{e.get('rule')}] "
-                f"{e.get('path')}: add a one-line justification"
-            )
+        _print_report_entries(findings, stale, unjustified)
         for e in errors:
             print(f"parse error: {e}")
-        baselined = report["total"] - len(findings)
         print(
             f"graftlint: {len(findings)} finding"
             f"{'' if len(findings) == 1 else 's'}"
@@ -312,7 +384,7 @@ def _main_ir(args: argparse.Namespace, repo_root: str) -> int:
         repo_root, budgets_mod.DEFAULT_MANIFEST
     )
     baseline_path = args.baseline or os.path.join(
-        repo_root, "graftlint.ir.baseline.json"
+        repo_root, IR_DEFAULT_BASELINE
     )
     if not _json_files_parse(budgets_path, baseline_path):
         return 2
@@ -383,36 +455,15 @@ def _main_ir(args: argparse.Namespace, repo_root: str) -> int:
     budget_unjustified = report["budget_unjustified"]
     errors = report["errors"]
 
+    baselined = len(report["all_findings"]) - len(findings)
     if args.json:
-        print(
-            json.dumps(
-                {
-                    "findings": [f.to_dict() for f in findings],
-                    "stale_baseline": stale,
-                    "unjustified_baseline": unjustified,
-                    "unjustified_budgets": budget_unjustified,
-                    "improvements": report["improvements"],
-                    "errors": errors,
-                    "measured": report["measured"],
-                    "baselined": len(report["all_findings"])
-                    - len(findings),
-                },
-                indent=2,
-            )
-        )
+        payload = _tier_payload(findings, stale, unjustified, errors, baselined)
+        payload["unjustified_budgets"] = budget_unjustified
+        payload["improvements"] = report["improvements"]
+        payload["measured"] = report["measured"]
+        print(json.dumps(payload, indent=2))
     else:
-        for f in findings:
-            print(f.render())
-        for e in stale:
-            print(
-                f"stale baseline entry: [{e.get('rule')}] {e.get('path')}: "
-                f"{e.get('text')!r} no longer matches — remove it"
-            )
-        for e in unjustified:
-            print(
-                f"unjustified baseline entry: [{e.get('rule')}] "
-                f"{e.get('path')}: add a one-line justification"
-            )
+        _print_report_entries(findings, stale, unjustified)
         for name in budget_unjustified:
             print(
                 f"unjustified budget entry: {name}: add a one-line "
@@ -420,7 +471,6 @@ def _main_ir(args: argparse.Namespace, repo_root: str) -> int:
             )
         for e in errors:
             print(f"trace error: {e}")
-        baselined = len(report["all_findings"]) - len(findings)
         print(
             f"graftlint --ir: {len(findings)} finding"
             f"{'' if len(findings) == 1 else 's'}, "
@@ -440,6 +490,266 @@ def _main_ir(args: argparse.Namespace, repo_root: str) -> int:
     if findings or stale or unjustified or budget_unjustified:
         return 1
     return 0
+
+
+def _main_race(args: argparse.Namespace, repo_root: str) -> int:
+    """The `--race` tier's static half (analysis/locks.py): whole-program
+    lock analysis under graftlint.race.baseline.json."""
+    if args.paths or args.changed_only:
+        # lock-order inversions are a property of the PROGRAM: thread 1's
+        # half may live in an unchanged file — a path subset would hide
+        # exactly the cross-module bugs the tier exists for
+        print(
+            "graftlint: --race is whole-program; it takes no paths and "
+            "no --changed-only",
+            file=sys.stderr,
+        )
+        return 2
+    if args.budgets or args.reference_root != _DEFAULT_REFERENCE_ROOT:
+        # an explicitly passed option that does nothing must be refused
+        # (same principle --all enforces): a green run that never read
+        # the manifest the operator pointed at is a lie
+        print(
+            "graftlint: --budgets/--reference-root are not used by "
+            "--race (budgets belong to --ir; citations to the AST tier)",
+            file=sys.stderr,
+        )
+        return 2
+    from karpenter_tpu.analysis import locks
+
+    rule_ids = (
+        {r.strip() for r in args.rules.split(",")} if args.rules else None
+    )
+    if rule_ids is not None:
+        # a typo'd id must not read as "nothing to check, clean"
+        unknown = rule_ids - set(locks.RACE_RULES)
+        if unknown:
+            print(
+                "graftlint: unknown race rule id(s): "
+                + ", ".join(sorted(unknown))
+                + " (see --list-rules)",
+                file=sys.stderr,
+            )
+            return 2
+    baseline_path = args.baseline or os.path.join(
+        repo_root, locks.DEFAULT_BASELINE
+    )
+    if not _json_files_parse(baseline_path):
+        return 2
+
+    report = locks.run_race_analysis(
+        repo_root, baseline_path=baseline_path, rule_ids=rule_ids
+    )
+
+    if args.write_baseline:
+        if rule_ids is not None:
+            # a partial run sees a slice of the findings; rewriting from
+            # it would truncate every out-of-scope curated entry
+            print(
+                "graftlint: --write-baseline under --race requires a "
+                "full run (no --rules)",
+                file=sys.stderr,
+            )
+            return 2
+        return _write_baseline_file(baseline_path, report["all_findings"])
+
+    findings = report["findings"]
+    # partial runs (--rules) leave baseline entries for out-of-scope
+    # rules unmatched — expected, not staleness (the AST tier's subset
+    # convention); only the full run polices baseline rot
+    stale = [] if rule_ids is not None else report["stale"]
+    unjustified = report["unjustified"]
+    errors = report["errors"]
+
+    baselined = report["total"] - len(findings)
+    if args.json:
+        print(
+            json.dumps(
+                _tier_payload(findings, stale, unjustified, errors, baselined),
+                indent=2,
+            )
+        )
+    else:
+        _print_report_entries(findings, stale, unjustified)
+        for e in errors:
+            print(f"parse error: {e}")
+        print(
+            f"graftlint --race: {len(findings)} finding"
+            f"{'' if len(findings) == 1 else 's'}"
+            + (f", {baselined} baselined" if baselined else "")
+            + (f", {len(stale)} stale" if stale else "")
+        )
+
+    if errors:
+        # whole-program analysis over a partial program is a broken
+        # gate, not a lint verdict: the unparsable file could hold the
+        # other half of an inversion — exit 2 even when findings also
+        # exist (the IR tier's trace-error convention, not the AST
+        # tier's, because only these two tiers claim completeness)
+        return 2
+    if findings or stale or unjustified:
+        return 1
+    return 0
+
+
+def _main_all(args: argparse.Namespace, repo_root: str) -> int:
+    """`--all`: AST + race + IR in one invocation, merged `--json`
+    output, worst-case exit code (2 > 1 > 0). Read-only by design — the
+    write modes stay per-tier so a rewrite is always an explicit,
+    single-tier act."""
+    if (
+        args.paths
+        or args.changed_only
+        or args.rules
+        or args.write_baseline
+        or args.write_budgets
+        or args.baseline
+        or args.budgets
+    ):
+        print(
+            "graftlint: --all runs every tier full-tree with each tier's "
+            "default baseline and budget manifest; it takes no paths/"
+            "--changed-only/--rules/--baseline/--budgets/--write-* (use "
+            "the per-tier modes for those)",
+            file=sys.stderr,
+        )
+        return 2
+
+    # the same pre-flight every single-tier mode runs: a trailing-comma
+    # typo in a hand-edited gate file must be the documented exit-2
+    # diagnostic, not a JSONDecodeError traceback out of the first tier
+    # that loads it
+    from karpenter_tpu.analysis import locks
+
+    gate_files = [
+        os.path.join(repo_root, "graftlint.baseline.json"),
+        os.path.join(repo_root, locks.DEFAULT_BASELINE),
+        os.path.join(repo_root, IR_DEFAULT_BASELINE),
+    ]
+    try:
+        from karpenter_tpu.analysis import budgets as _budgets_preflight
+
+        gate_files.append(
+            os.path.join(repo_root, _budgets_preflight.DEFAULT_MANIFEST)
+        )
+    except ImportError:
+        pass  # IR tier will report itself unavailable below
+    if not _json_files_parse(*gate_files):
+        return 2
+
+    payload: dict = {}
+    codes: list[int] = []
+
+    def _tier_code(report: dict, extra_unjustified: int = 0) -> int:
+        if (
+            report["findings"]
+            or report["stale"]
+            or report["unjustified"]
+            or extra_unjustified
+        ):
+            return 1
+        if report["errors"]:
+            return 2
+        return 0
+
+    ast_report = run_analysis(repo_root, reference_root=args.reference_root)
+    codes.append(_tier_code(ast_report))
+    payload["ast"] = _tier_payload(
+        ast_report["findings"],
+        ast_report["stale"],
+        ast_report["unjustified"],
+        ast_report["errors"],
+        ast_report["total"] - len(ast_report["findings"]),
+    )
+    payload["ast"]["exit_code"] = codes[-1]
+
+    race_report = locks.run_race_analysis(repo_root)
+    # parse errors make the whole-program claim false: broken gate (2),
+    # mirroring the IR tier's trace-error convention below
+    codes.append(2 if race_report["errors"] else _tier_code(race_report))
+    payload["race"] = _tier_payload(
+        race_report["findings"],
+        race_report["stale"],
+        race_report["unjustified"],
+        race_report["errors"],
+        race_report["total"] - len(race_report["findings"]),
+    )
+    payload["race"]["exit_code"] = codes[-1]
+
+    try:
+        from karpenter_tpu.analysis import budgets as budgets_mod
+        from karpenter_tpu.analysis import ir
+
+        ir_report = ir.run_ir_analysis(
+            repo_root,
+            budgets_path=os.path.join(repo_root, budgets_mod.DEFAULT_MANIFEST),
+            baseline_path=os.path.join(repo_root, IR_DEFAULT_BASELINE),
+        )
+        # mirror _main_ir: a kernel that no longer traces is a broken
+        # gate (2), even when comparison findings also exist
+        ir_code = (
+            2
+            if ir_report["errors"]
+            else _tier_code(
+                ir_report, extra_unjustified=len(ir_report["budget_unjustified"])
+            )
+        )
+        codes.append(ir_code)
+        payload["ir"] = _tier_payload(
+            ir_report["findings"],
+            ir_report["stale"],
+            ir_report["unjustified"],
+            ir_report["errors"],
+            len(ir_report["all_findings"]) - len(ir_report["findings"]),
+        )
+        payload["ir"]["unjustified_budgets"] = ir_report["budget_unjustified"]
+        payload["ir"]["improvements"] = ir_report["improvements"]
+        payload["ir"]["measured"] = ir_report["measured"]
+        payload["ir"]["exit_code"] = ir_code
+    except ImportError as e:
+        codes.append(2)
+        payload["ir"] = {"unavailable": str(e), "exit_code": 2}
+
+    worst = max(codes)
+    if args.json:
+        payload["exit_code"] = worst
+        print(json.dumps(payload, indent=2))
+    else:
+        for tier in ("ast", "race", "ir"):
+            rep = payload[tier]
+            if "unavailable" in rep:
+                print(f"[{tier}] unavailable: {rep['unavailable']}")
+                continue
+            for f in rep["findings"]:
+                print(f"[{tier}] {f['path']}:{f['line']}: [{f['rule']}] {f['message']}")
+            _print_baseline_problems(
+                rep["stale_baseline"],
+                rep["unjustified_baseline"],
+                prefix=f"[{tier}] ",
+            )
+            for name in rep.get("unjustified_budgets", []):
+                print(
+                    f"[{tier}] unjustified budget entry: {name}: add a "
+                    "one-line justification in kernel_budgets.json"
+                )
+            for e in rep["errors"]:
+                print(f"[{tier}] error: {e}")
+            problems = (
+                len(rep["findings"])
+                + len(rep["stale_baseline"])
+                + len(rep["unjustified_baseline"])
+                + len(rep.get("unjustified_budgets", []))
+            )
+            print(
+                f"graftlint --all [{tier}]: {len(rep['findings'])} finding"
+                f"{'' if len(rep['findings']) == 1 else 's'}"
+                + (f", {rep['baselined']} baselined" if rep["baselined"] else "")
+                + ("" if problems == len(rep["findings"]) else
+                   f", {problems - len(rep['findings'])} baseline/budget problem(s)")
+                + f" (exit {rep['exit_code']})"
+            )
+        print(f"graftlint --all: worst exit {worst}")
+    return worst
 
 
 if __name__ == "__main__":

@@ -57,6 +57,13 @@ class Finding:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
 
 
+# the IR tier's baseline filename, hoisted here (not analysis/ir.py)
+# because ir.py imports JAX and the CLI's JSON pre-flight must be able
+# to name the file without paying that import; locks.py keeps its own
+# DEFAULT_BASELINE the same way
+IR_DEFAULT_BASELINE = "graftlint.ir.baseline.json"
+
+
 @dataclasses.dataclass
 class Config:
     """Per-run settings rules consult through `ctx.config`."""

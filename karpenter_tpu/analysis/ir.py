@@ -44,7 +44,7 @@ import functools
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from karpenter_tpu.analysis import budgets as budgets_mod
-from karpenter_tpu.analysis.engine import Finding
+from karpenter_tpu.analysis.engine import IR_DEFAULT_BASELINE, Finding
 
 IR_RULES: dict[str, str] = {
     "ir-callbacks": (
@@ -766,7 +766,7 @@ def run_ir_analysis(
     baseline_path = (
         baseline_path
         if baseline_path is not None
-        else os.path.join(repo_root, "graftlint.ir.baseline.json")
+        else os.path.join(repo_root, IR_DEFAULT_BASELINE)
     )
     manifest = budgets_mod.BudgetManifest.load(budgets_path)
     measured, findings, errors = measure(rule_ids)

@@ -110,7 +110,12 @@ class SimKube:
         in _pump AFTER the lock is released — a subscriber that blocks or
         takes another lock must not deadlock against worker-pool
         reconciles doing store CRUD, and subscriber work must not
-        serialize the store."""
+        serialize the store. The queue-then-drain shape keeps the store
+        lock a leaf in the program's acquisition graph: graftlint's
+        race-blocking-hold flags blocking calls SimKube itself makes
+        under the lock, but a subscriber's own locks live in other
+        classes the static graph does not follow — keeping delivery
+        outside the lock is what makes that blind spot moot."""
         self._events.append((event, kind, obj))
 
     def _pump(self) -> None:

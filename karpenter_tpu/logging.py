@@ -107,13 +107,45 @@ root = Logger(name="karpenter")
 @contextmanager
 def capture(level: str = "debug"):
     """Route the root logger into a buffer and yield the parsed records —
-    the test harness for controller logging."""
+    the test harness for controller logging.
+
+    Also hooks `threading.excepthook` for the duration: an uncaught
+    exception in a background thread (a server connection handler dying,
+    a worker-pool task exploding outside its catch) becomes an ERROR
+    record named `karpenter.threading` AND lands in
+    `records.thread_exceptions`, so a test can assert on it — instead of
+    the default behavior, where the traceback prints to the real stderr
+    and the test passes in silence."""
     buf = io.StringIO()
     old_stream, old_level = root._stream, root._level_no
     old_clock = root._clock
     root._stream = buf
     root._level_no = _level_no(level)
     root._capturing = True
+    old_hook = threading.excepthook
+    thread_exceptions: list[dict] = []
+    thread_log = root.named("threading")
+
+    def _thread_hook(args):
+        info = {
+            "thread": getattr(args.thread, "name", "?"),
+            "exc_type": getattr(args.exc_type, "__name__", "?"),
+            "exc_value": args.exc_value,
+        }
+        thread_exceptions.append(info)
+        thread_log.error(
+            "uncaught exception in background thread",
+            thread=info["thread"],
+            error=f"{info['exc_type']}: {info['exc_value']}",
+        )
+        # CHAIN the previous hook: inside a racert-instrumented test the
+        # previous hook is the race witness — capture() recording the
+        # exception must not hide it from witness.assert_no_thread_
+        # exceptions(); outside, it keeps pytest's threadexception
+        # reporting (or the stderr default) intact.
+        old_hook(args)
+
+    threading.excepthook = _thread_hook
 
     class Records(list):
         def refresh(self):
@@ -124,10 +156,12 @@ def capture(level: str = "debug"):
             return self
 
     records = Records()
+    records.thread_exceptions = thread_exceptions
     try:
         yield records
     finally:
         records.refresh()
+        threading.excepthook = old_hook
         root._stream = old_stream
         root._level_no = old_level
         root._clock = old_clock

@@ -134,7 +134,10 @@ class Store:
         self._owned: dict[str, list[dict]] = {}
         # controllers updating the same store may run on worker pools;
         # two racing update(key) calls must not interleave delete/set and
-        # leak orphaned series (lock order store -> gauge, never inverse)
+        # leak orphaned series. Lock order store -> gauge, never inverse:
+        # the graftlint race tier witnesses this at runtime (racert, under
+        # the faults suite) — a gauge-holding path calling back into a
+        # Store would surface as a lock-order inversion there.
         self._lock = threading.Lock()
 
     def update(self, key: str, series: list[tuple[dict, float]]) -> None:

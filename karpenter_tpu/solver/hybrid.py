@@ -39,6 +39,7 @@ failure ladder (docs/resilience.md):
 from __future__ import annotations
 
 import copy
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -299,7 +300,17 @@ class CircuitBreaker:
     tests pass FakeClock.now so cooldowns ride simulated time). `name`
     labels this instance's gauge series — two live breakers (a drained
     control plane overlapping its successor) must not overwrite each
-    other's exported state."""
+    other's exported state.
+
+    Thread safety: the breaker is driven from every concurrent request
+    path (SolverServer handler threads, worker-pool reconciles all
+    funnel through ResilientSolver.solve), so the trip/reclose state
+    machine and the failure counter mutate under one small lock —
+    without it, `consecutive_failures += 1` is a lost-update race, and
+    the open->half-open transition in allow() could not be made a
+    single-winner decision (the lock is what lets exactly ONE racing
+    caller claim the half-open probe; everyone else keeps cooling down
+    in-process). Same shape as the PR 2 metrics Store/Registry fix."""
 
     def __init__(
         self,
@@ -312,41 +323,64 @@ class CircuitBreaker:
         self.cooldown_seconds = cooldown_seconds
         self._clock = clock or time.monotonic
         self.name = name
+        self._lock = threading.Lock()
         self.state = "closed"
         self.consecutive_failures = 0
         self._opened_at: Optional[float] = None
-        self._publish()
+        self._probe_at: Optional[float] = None
+        # nothing races __init__, but *_locked means caller-holds — the
+        # convention stays checkable only if every call site honors it
+        with self._lock:
+            self._publish_locked()
 
-    def _publish(self) -> None:
+    def _publish_locked(self) -> None:
         BREAKER_STATE.set(
             _BREAKER_STATE_CODES[self.state], {"breaker": self.name}
         )
 
     def allow(self) -> bool:
-        """May the next solve attempt the sidecar?"""
-        if self.state == "closed":
-            return True
-        if self._clock() - self._opened_at >= self.cooldown_seconds:
-            self.state = "half-open"
-            self._publish()
-            return True
-        return False
+        """May the next solve attempt the sidecar? Half-open admits ONE
+        probe: the open->half-open transition returns True exactly once
+        under the lock; callers racing in behind it see half-open and go
+        straight in-process until the probe's record_success/
+        record_failure resolves the state. A probe that never reports
+        back (its thread killed by BaseException between allow() and
+        record_*) must not wedge the breaker refusing the sidecar
+        forever: after a full cooldown with no verdict, half-open
+        re-admits a fresh probe."""
+        now = self._clock()
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "half-open":
+                if now - self._probe_at >= self.cooldown_seconds:
+                    self._probe_at = now  # lost probe; this caller takes over
+                    return True
+                return False  # a probe is already in flight
+            if now - self._opened_at >= self.cooldown_seconds:
+                self.state = "half-open"
+                self._probe_at = now
+                self._publish_locked()
+                return True
+            return False
 
     def record_success(self) -> None:
-        self.state = "closed"
-        self.consecutive_failures = 0
-        self._opened_at = None
-        self._publish()
+        with self._lock:
+            self.state = "closed"
+            self.consecutive_failures = 0
+            self._opened_at = None
+            self._publish_locked()
 
     def record_failure(self) -> None:
-        self.consecutive_failures += 1
-        if (
-            self.state == "half-open"
-            or self.consecutive_failures >= self.failure_threshold
-        ):
-            self.state = "open"
-            self._opened_at = self._clock()
-        self._publish()
+        with self._lock:
+            self.consecutive_failures += 1
+            if (
+                self.state == "half-open"
+                or self.consecutive_failures >= self.failure_threshold
+            ):
+                self.state = "open"
+                self._opened_at = self._clock()
+            self._publish_locked()
 
 
 class RemoteNodeClaim:

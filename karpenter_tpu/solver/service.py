@@ -479,7 +479,15 @@ class SolverServer:
     violations close only the offending connection; anything unexpected is
     logged and the loop keeps serving. stop() drains gracefully — the
     listener closes first, in-flight handlers get `drain_seconds` to flush
-    their responses."""
+    their responses.
+
+    Concurrency contract (graftlint race tier): the two locks here are
+    leaves — nothing blocking runs under either (_conns_lock guards set
+    membership only; stop() snapshots the set under the lock and joins
+    OUTSIDE it), and neither nests inside the other, so the server
+    contributes no edges to the program's lock acquisition graph. The
+    fault suite runs with racert-instrumented locks to witness exactly
+    that under real handler-thread interleavings."""
 
     def __init__(self, socket_path: str, drain_seconds: float = 30.0):
         self.socket_path = socket_path

@@ -73,3 +73,29 @@ def pytest_runtest_call(item):
     finally:
         signal.setitimer(signal.ITIMER_REAL, 0)
         signal.signal(signal.SIGALRM, old)
+
+
+# tsan-lite runtime race witness (karpenter_tpu/analysis/racert.py): every
+# `faults`-marked test — the whole fault-injection/chaos envelope exercises
+# the service boundary's real thread interleavings — runs with instrumented
+# locks, and fails on any observed lock-order inversion or uncaught
+# background-thread exception. Opt in from any other test with
+# @pytest.mark.racert. Overhead is a raw frame walk per acquire
+# (microseconds), so the tier-1 budget is untouched.
+@pytest.fixture(autouse=True)
+def _racert_witness(request):
+    if (
+        request.node.get_closest_marker("faults") is None
+        and request.node.get_closest_marker("racert") is None
+    ):
+        yield
+        return
+    from karpenter_tpu.analysis import racert
+
+    witness = racert.instrument()
+    try:
+        yield witness
+    finally:
+        racert.uninstrument()
+    witness.assert_no_inversions()
+    witness.assert_no_thread_exceptions()

@@ -1,0 +1,1460 @@
+"""graftlint race-tier gate: fixture-driven positive/negative cases per
+static rule, the runtime witness (inversion, long-hold, background
+exceptions), CLI exit codes, the no-JAX subprocess pin, and the
+full-tree run.
+
+Mirrors tests/test_static_analysis.py's structure; the static fixtures
+are written into a throwaway repo layout because the race tier is
+whole-program (a cycle's two halves may sit in different methods or
+files — inline single-rule snippets would under-test the
+interprocedural plumbing).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.analysis import racert
+from karpenter_tpu.analysis.__main__ import main as graftlint_main
+from karpenter_tpu.analysis.locks import RACE_RULES, run_race_analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def race_findings(tmp_path, files: dict[str, str], rule_ids=None):
+    """Write `files` (relpath -> source) into a throwaway repo and run
+    the static race analysis over it."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+    report = run_race_analysis(str(tmp_path), rule_ids=rule_ids)
+    assert report["errors"] == []
+    return report["findings"]
+
+
+# ---------------------------------------------------------------------------
+# race-lock-order
+
+
+def test_lock_order_flags_two_order_cycle(tmp_path):
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class Inverted:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """
+        },
+        rule_ids={"race-lock-order"},
+    )
+    assert [f.rule for f in findings] == ["race-lock-order"]
+    assert "cycle" in findings[0].message
+
+
+def test_lock_order_follows_same_class_calls(tmp_path):
+    """Interprocedural: one half of the inversion hides behind a method
+    call made while a lock is held."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class Hidden:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        self._grab_b()
+
+                def _grab_b(self):
+                    with self._b:
+                        pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """
+        },
+        rule_ids={"race-lock-order"},
+    )
+    assert len(findings) == 1 and "cycle" in findings[0].message
+
+
+def test_lock_order_flags_self_deadlock_on_plain_lock(tmp_path):
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class SelfDead:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bump(self):
+                    with self._lock:
+                        self.flush()
+
+                def flush(self):
+                    with self._lock:
+                        pass
+            """
+        },
+        rule_ids={"race-lock-order"},
+    )
+    assert len(findings) == 1 and "self-deadlock" in findings[0].message
+
+
+def test_lock_order_allows_consistent_order_and_rlock_reentry(tmp_path):
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class Consistent:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+            class Reentrant:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+            """
+        },
+        rule_ids={"race-lock-order"},
+    )
+    assert findings == []
+
+
+def test_lock_order_ignores_branch_alternative_acquires(tmp_path):
+    """`if fast: lock.acquire() else: lock.acquire()` is ONE hold — the
+    two acquires can never coexist, and an acquire-statement span (which
+    runs to the next release line, textually covering the sibling
+    branch) must not read as a self-deadlock. The genuinely sequential
+    double-acquire right below must still be flagged."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class BranchAlternative:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self, fast):
+                    if fast:
+                        self._lock.acquire()
+                    else:
+                        self._lock.acquire()
+                    self.n += 1
+                    self._lock.release()
+            """
+        },
+        rule_ids={"race-lock-order"},
+    )
+    assert findings == []
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/y.py": """
+            import threading
+
+            class Sequential:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    self._lock.acquire()
+                    self._lock.acquire()
+                    self._lock.release()
+                    self._lock.release()
+            """
+        },
+        rule_ids={"race-lock-order"},
+    )
+    assert len(findings) == 1 and "self-deadlock" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# race-blocking-hold
+
+
+def test_blocking_hold_flags_sleep_socket_and_untimed_get(tmp_path):
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+            import time
+
+            class Blocky:
+                def __init__(self, sock, q):
+                    self._lock = threading.Lock()
+                    self.sock = sock
+                    self.q = q
+
+                def hold_sleep(self):
+                    with self._lock:
+                        time.sleep(1.0)
+
+                def hold_recv(self):
+                    with self._lock:
+                        return self.sock.recv(1024)
+
+                def hold_get(self):
+                    with self._lock:
+                        return self.q.get()
+            """
+        },
+        rule_ids={"race-blocking-hold"},
+    )
+    assert len(findings) == 3
+    msgs = " ".join(f.message for f in findings)
+    assert "time.sleep" in msgs and ".recv" in msgs and ".get() with no timeout" in msgs
+
+
+def test_blocking_hold_follows_calls_and_locked_contract(tmp_path):
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+            import time
+
+            class Indirect:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def hold(self):
+                    with self._lock:
+                        self._slow()
+
+                def _slow(self):
+                    time.sleep(0.5)
+
+                def _flush_locked(self):
+                    time.sleep(0.1)
+            """
+        },
+        rule_ids={"race-blocking-hold"},
+    )
+    # one at the call site (hold -> _slow), one inside the *_locked
+    # method (the caller holds a lock by contract)
+    assert len(findings) == 2
+    msgs = " ".join(f.message for f in findings)
+    assert "_slow" in msgs and "_flush_locked" in msgs
+
+
+def test_blocking_hold_locked_callee_reported_once(tmp_path):
+    """A blocking call inside a *_locked method is one defect: reported
+    at the definition (the caller-holds contract), NOT again at every
+    locked call site — one suppression/baseline entry must cover it."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+            import time
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def flush(self):
+                    with self._lock:
+                        self._drain_locked()
+
+                def _drain_locked(self):
+                    time.sleep(0.1)
+            """
+        },
+        rule_ids={"race-blocking-hold"},
+    )
+    assert len(findings) == 1
+    assert "_drain_locked" in findings[0].message
+
+
+def test_blocking_hold_ignores_sleep_in_opposite_branch(tmp_path):
+    """An acquire() span runs to the next release line, textually
+    covering the else branch — but a sleep there never runs with the
+    lock held."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+            import time
+
+            class BranchWait:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def acquire_or_wait(self, fast):
+                    if fast:
+                        self._lock.acquire()
+                    else:
+                        time.sleep(0.1)
+                        return False
+                    self.n += 1
+                    self._lock.release()
+                    return True
+            """
+        },
+        rule_ids={"race-blocking-hold"},
+    )
+    assert findings == []
+
+
+def test_blocking_hold_allows_unlocked_sleep_and_timed_get(tmp_path):
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+            import time
+
+            class Fine:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self.q = q
+                    self.n = 0
+
+                def snapshot_then_wait(self):
+                    with self._lock:
+                        n = self.n
+                    time.sleep(0.1)
+                    return n
+
+                def timed_get(self):
+                    with self._lock:
+                        return self.q.get(timeout=1.0)
+            """
+        },
+        rule_ids={"race-blocking-hold"},
+    )
+    assert findings == []
+
+
+def test_blocking_hold_device_sync_only_in_jax_modules(tmp_path):
+    files = {
+        "karpenter_tpu/jaxy.py": """
+        import threading
+        import jax
+        import numpy as np
+
+        class Dev:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fetch(self, x):
+                with self._lock:
+                    return np.asarray(x)
+        """,
+        "karpenter_tpu/hosty.py": """
+        import threading
+        import numpy as np
+
+        class Host:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fetch(self, x):
+                with self._lock:
+                    return np.asarray(x)
+        """,
+    }
+    findings = race_findings(tmp_path, files, rule_ids={"race-blocking-hold"})
+    assert [f.path for f in findings] == ["karpenter_tpu/jaxy.py"]
+    assert "device fetch" in findings[0].message
+
+
+def test_blocking_hold_sees_module_level_locks_in_methods(tmp_path):
+    """A class method holding a MODULE-level lock must land on the same
+    graph node as module functions holding it — and its blocking calls
+    must be flagged."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+            import time
+
+            _mod_lock = threading.Lock()
+
+            def module_hold():
+                with _mod_lock:
+                    time.sleep(0.2)
+
+            class UsesModuleLock:
+                def hold(self):
+                    with _mod_lock:
+                        time.sleep(0.1)
+            """
+        },
+        rule_ids={"race-blocking-hold"},
+    )
+    assert len(findings) == 2  # module function AND the class method
+
+
+def test_lock_order_cycle_across_class_and_module_lock(tmp_path):
+    """A cycle whose halves mix a class lock and a module lock: the
+    class-scope ref and the module-scope ref must unify to one node."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            _mod_lock = threading.Lock()
+
+            class Mixed:
+                def __init__(self):
+                    self._own = threading.Lock()
+
+                def one(self):
+                    with self._own:
+                        with _mod_lock:
+                            pass
+
+                def two(self):
+                    with _mod_lock:
+                        with self._own:
+                            pass
+            """
+        },
+        rule_ids={"race-lock-order"},
+    )
+    assert len(findings) == 1 and "cycle" in findings[0].message
+
+
+def test_blocking_hold_flags_block_true_get_and_allows_block_false(tmp_path):
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class Q:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self.q = q
+
+                def blocking(self):
+                    with self._lock:
+                        return self.q.get(block=True)
+
+                def non_blocking(self):
+                    with self._lock:
+                        return self.q.get(block=False)
+            """
+        },
+        rule_ids={"race-blocking-hold"},
+    )
+    assert len(findings) == 1
+    assert findings[0].line and "no timeout" in findings[0].message
+
+
+def test_lock_inventory_skips_nested_classes(tmp_path):
+    """An inner class's `self._mu` is a different object than the outer
+    class's — inventorying it on the outer class invents phantom held
+    spans (false blocking-hold findings) and splits one lock role across
+    two graph identities."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+            import time
+
+            class Outer:
+                def __init__(self):
+                    self._mu = object()  # NOT a lock
+
+                    class Inner:
+                        def __init__(self):
+                            self._mu = threading.Lock()
+
+                    self.inner = Inner()
+
+                def work(self):
+                    with self._mu:  # plain context manager, no held span
+                        time.sleep(1)
+            """
+        },
+        rule_ids={"race-blocking-hold"},
+    )
+    assert findings == []
+
+
+def test_lock_inventory_sees_annotated_assignments(tmp_path):
+    """`self._lock: threading.Lock = threading.Lock()` declares the same
+    shared lock as the bare assignment — an AnnAssign-blind inventory
+    would silently drop every rule over it and the gate would stay
+    green (whole-program completeness claim broken)."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+            import time
+
+            ann_mod_lock: threading.Lock = threading.Lock()
+
+            class Annotated:
+                def __init__(self):
+                    self._lock: threading.Lock = threading.Lock()
+
+                def hold(self):
+                    with self._lock:
+                        time.sleep(1)
+
+            def mod_hold():
+                with ann_mod_lock:
+                    time.sleep(1)
+            """
+        },
+        rule_ids={"race-blocking-hold"},
+    )
+    assert sorted(f.rule for f in findings) == ["race-blocking-hold"] * 2
+
+
+# ---------------------------------------------------------------------------
+# race-unguarded-shared
+
+
+def test_unguarded_shared_flags_thread_vs_public_write(tmp_path):
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class Shared:
+                def __init__(self):
+                    self.count = 0
+                    self._t = threading.Thread(target=self._loop, daemon=True)
+
+                def _loop(self):
+                    self.count += 1
+
+                def reset(self):
+                    self.count = 0
+            """
+        },
+        rule_ids={"race-unguarded-shared"},
+    )
+    assert len(findings) == 1
+    assert "Shared.count" in findings[0].message
+
+
+def test_unguarded_shared_flags_disjoint_locks(tmp_path):
+    """Both sides guarded — by DIFFERENT locks — is still a race: the
+    rule demands one common lock across every write."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class Disjoint:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self.count = 0
+                    self._t = threading.Thread(target=self._loop, daemon=True)
+
+                def _loop(self):
+                    with self._a:
+                        self.count += 1
+
+                def reset(self):
+                    with self._b:
+                        self.count = 0
+            """
+        },
+        rule_ids={"race-unguarded-shared"},
+    )
+    assert len(findings) == 1 and "no common lock" in findings[0].message
+
+
+def test_unguarded_shared_follows_public_delegation(tmp_path):
+    """The public side is interprocedural too: `stop()` delegating the
+    write to a private helper races exactly like an inline assignment —
+    and the same delegation with a common lock stays clean."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class Delegating:
+                def __init__(self):
+                    self._running = True
+                    self._t = threading.Thread(target=self._serve, daemon=True)
+
+                def _serve(self):
+                    self._running = True
+
+                def stop(self):
+                    self._shutdown()
+
+                def _shutdown(self):
+                    self._running = False
+            """
+        },
+        rule_ids={"race-unguarded-shared"},
+    )
+    assert len(findings) == 1
+    assert "Delegating._running" in findings[0].message
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/y.py": """
+            import threading
+
+            class Guarded:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._running = True
+                    self._t = threading.Thread(target=self._serve, daemon=True)
+
+                def _serve(self):
+                    with self._lock:
+                        self._running = True
+
+                def stop(self):
+                    self._shutdown()
+
+                def _shutdown(self):
+                    with self._lock:
+                        self._running = False
+            """
+        },
+        rule_ids={"race-unguarded-shared"},
+    )
+    assert [f.message for f in findings if "Guarded" in f.message] == []
+
+
+def test_unguarded_shared_inherits_callers_held_locks(tmp_path):
+    """Guard sets follow the call closure exactly like write sites do:
+    `with self._lock: self._shutdown()` keeps `_shutdown`'s writes
+    guarded (guarded delegation is the ordinary pattern, not a finding),
+    while a caller holding the WRONG lock — or a second caller holding
+    nothing — does not count."""
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class CallerGuarded:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._flag = True
+                    self._t = threading.Thread(target=self._serve, daemon=True)
+
+                def _serve(self):
+                    with self._lock:
+                        self._flag = True
+
+                def stop(self):
+                    with self._lock:
+                        self._shutdown()
+
+                def _shutdown(self):
+                    self._flag = False
+
+            class WrongLock:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._other = threading.Lock()
+                    self._flag = True
+                    self._t = threading.Thread(target=self._serve, daemon=True)
+
+                def _serve(self):
+                    with self._lock:
+                        self._flag = True
+
+                def stop(self):
+                    with self._other:
+                        self._shutdown()
+
+                def _shutdown(self):
+                    self._flag = False
+
+            class LeakyCaller:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._flag = True
+                    self._t = threading.Thread(target=self._serve, daemon=True)
+
+                def _serve(self):
+                    with self._lock:
+                        self._flag = True
+
+                def stop(self):
+                    with self._lock:
+                        self._shutdown()
+
+                def kick(self):
+                    self._shutdown()
+
+                def _shutdown(self):
+                    self._flag = False
+            """
+        },
+        rule_ids={"race-unguarded-shared"},
+    )
+    msgs = [f.message for f in findings]
+    assert not any("CallerGuarded" in m for m in msgs), msgs
+    assert any("WrongLock._flag" in m for m in msgs), msgs
+    assert any("LeakyCaller._flag" in m for m in msgs), msgs
+    assert len(findings) == 2
+
+
+def test_unguarded_shared_allows_common_lock_and_thread_closure(tmp_path):
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+
+            class Guarded:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    self.private_only = 0
+                    self._t = threading.Thread(target=self._loop, daemon=True)
+
+                def _loop(self):
+                    with self._lock:
+                        self.count += 1
+                    self._helper()
+
+                def _helper(self):
+                    self.private_only += 1
+
+                def reset(self):
+                    with self._lock:
+                        self.count = 0
+
+                def reset_locked(self):
+                    self.count = -1
+            """
+        },
+        rule_ids={"race-unguarded-shared"},
+    )
+    # count: common lock (the *_locked method counts as guarded by
+    # contract); private_only: written only from thread-side/private code
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline mechanics (engine integration)
+
+
+def test_race_findings_honor_suppressions(tmp_path):
+    findings = race_findings(
+        tmp_path,
+        {
+            "karpenter_tpu/x.py": """
+            import threading
+            import time
+
+            class Blocky:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def hold(self):
+                    with self._lock:
+                        time.sleep(1.0)  # graftlint: disable=race-blocking-hold
+            """
+        },
+        rule_ids={"race-blocking-hold"},
+    )
+    assert findings == []
+
+
+def test_race_baseline_hides_and_reports_stale(tmp_path):
+    (tmp_path / "karpenter_tpu").mkdir(parents=True)
+    (tmp_path / "karpenter_tpu" / "x.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            import time
+
+            class Blocky:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def hold(self):
+                    with self._lock:
+                        time.sleep(1.0)
+            """
+        )
+    )
+    bl = tmp_path / "graftlint.race.baseline.json"
+    bl.write_text(
+        json.dumps(
+            {
+                "entries": [
+                    {
+                        "rule": "race-blocking-hold",
+                        "path": "karpenter_tpu/x.py",
+                        "text": "time.sleep(1.0)",
+                        "justification": "fixture: intentional hold",
+                    },
+                    {
+                        "rule": "race-lock-order",
+                        "path": "karpenter_tpu/gone.py",
+                        "text": "with self._a:",
+                        "justification": "rotted",
+                    },
+                ]
+            }
+        )
+    )
+    report = run_race_analysis(str(tmp_path), baseline_path=str(bl))
+    assert report["findings"] == []
+    assert [e["path"] for e in report["stale"]] == ["karpenter_tpu/gone.py"]
+
+
+# ---------------------------------------------------------------------------
+# runtime half: the racert witness
+
+
+@pytest.fixture()
+def witness():
+    w = racert.instrument(hold_ms=30.0)
+    try:
+        yield w
+    finally:
+        racert.uninstrument()
+
+
+def test_racert_witnesses_inversion_with_both_stacks(witness):
+    """Inversion detection needs no unlucky interleaving — taking the
+    locks in both orders SEQUENTIALLY is already the evidence (the
+    deadlock just has not fired yet)."""
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert len(witness.inversions) == 1
+    inv = witness.inversions[0]
+    assert set(inv["locks"]) == {a._racert_site, b._racert_site}
+    assert inv["order_a_then_b"]["stack"] and inv["order_b_then_a"]["stack"]
+    # the report's innermost frame is the USER's `with` statement, not
+    # the __enter__/acquire wrapper frames inside racert itself
+    for side in ("order_a_then_b", "order_b_then_a"):
+        stack = inv[side]["stack"]
+        assert "analysis/racert.py" not in stack[0], stack
+        assert "test_race_analysis.py" in stack[0], stack
+    with pytest.raises(AssertionError, match="inversion"):
+        witness.assert_no_inversions()
+
+
+def test_racert_identities_survive_chdir(witness, tmp_path, monkeypatch):
+    """Lock sites are ROLE identities (creation site, Eraser-style) and
+    are anchored to the cwd resolved ONCE at import — a chdir between
+    two creations of the same role must not split it into repo-relative
+    and absolute identities, or the two halves of this inversion could
+    never pair up."""
+
+    def mk():
+        a = threading.Lock()  # role A: this line IS the identity
+        b = threading.Lock()  # role B
+        return a, b
+
+    a1, b1 = mk()
+    with a1:
+        with b1:
+            pass
+    monkeypatch.chdir(tmp_path)
+    a2, b2 = mk()  # same creation sites, cwd moved underneath
+    assert a2._racert_site == a1._racert_site
+    with b2:
+        with a2:
+            pass
+    assert len(witness.inversions) == 1
+
+
+def test_racert_consistent_order_and_rlock_reentry_are_clean(witness):
+    a = threading.Lock()
+    b = threading.Lock()
+    r = threading.RLock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    with r:
+        with r:  # re-entry is not an edge, let alone an inversion
+            with a:
+                pass
+    witness.assert_no_inversions()
+    assert witness.edges[(a._racert_site, b._racert_site)]["count"] == 3
+
+
+def test_racert_flags_long_hold(witness):
+    lock = threading.Lock()
+    with lock:
+        time.sleep(0.06)  # witness instrumented with hold_ms=30
+    assert any(
+        h["site"] == lock._racert_site and h["held_ms"] >= 30.0
+        for h in witness.long_holds
+    )
+
+
+def test_racert_condition_wait_on_reentrant_rlock_is_not_a_hold(witness):
+    """Condition.wait drops EVERY RLock recursion level at once; the
+    witness must surrender them all too (on_release_save), or a
+    re-entrantly held lock parked in wait() is tracked as still held and
+    the whole blocked wait reports as a spurious long hold. The depth
+    comes back after the wake so the outer releases still balance."""
+    c = threading.Condition()
+    site = c._lock._racert_site
+
+    def waiter():
+        with c:
+            with c:  # depth 2: _release_save must surrender both
+                c.wait(timeout=10)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.08)  # > hold_ms=30 while the waiter is parked in wait()
+    with c:
+        c.notify()
+    t.join(timeout=10)
+    assert [h for h in witness.long_holds if h["site"] == site] == []
+    witness.assert_no_inversions()
+    witness.assert_no_thread_exceptions()
+    # the restored depth drained back to zero on the way out: the lock
+    # is genuinely free, not leaked at depth 1
+    assert c.acquire(timeout=1)
+    c.release()
+
+
+def test_racert_captures_background_thread_exception(witness):
+    def boom():
+        raise RuntimeError("synthetic background failure")
+
+    t = threading.Thread(target=boom, name="boom-thread")
+    t.start()
+    t.join(timeout=10)
+    assert [e["exc_type"] for e in witness.thread_exceptions] == ["RuntimeError"]
+    with pytest.raises(AssertionError, match="synthetic background failure"):
+        witness.assert_no_thread_exceptions()
+
+
+def test_racert_condition_and_queue_stay_functional(witness):
+    """Patched constructors must compose with stdlib users: queue.Queue
+    builds a Condition over an instrumented Lock and must still block,
+    wake, and witness cleanly."""
+    import queue
+
+    q = queue.Queue()
+    got = {}
+
+    def consume():
+        got["v"] = q.get(timeout=10)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    q.put(41 + 1)
+    t.join(timeout=10)
+    assert got["v"] == 42
+    witness.assert_no_inversions()
+    witness.assert_no_thread_exceptions()
+    assert witness.acquire_count > 0
+
+
+def test_racert_locked_matches_uninstrumented_surface(witness):
+    """The wrappers must not invent API the raw lock lacks: before 3.14
+    `_thread.RLock` has no locked(), so code calling it must fail under
+    instrumentation exactly as it does without (not work in prod and
+    crash only inside racert-marked tests, or vice versa)."""
+    lock = threading.Lock()
+    assert lock.locked() is False
+    with lock:
+        assert lock.locked() is True
+
+    raw_has_locked = hasattr(racert._RAW_RLOCK(), "locked")
+    r = threading.RLock()
+    if raw_has_locked:  # 3.14+
+        assert r.locked() is False
+    else:
+        with pytest.raises(AttributeError):
+            r.locked()
+
+
+def test_racert_uninstrument_restores_and_quiets_wrappers():
+    w = racert.instrument()
+    lock = threading.Lock()
+    assert isinstance(lock, racert._InstrumentedLock)
+    racert.uninstrument()
+    assert threading.Lock is racert._RAW_LOCK
+    assert racert.current() is None
+    before = w.acquire_count
+    with lock:  # leftover wrapper stays usable but reports nowhere
+        pass
+    assert w.acquire_count == before
+
+
+def test_logging_capture_records_background_thread_exception():
+    """Satellite: klog.capture() chains threading.excepthook so a dying
+    background thread surfaces as an ERROR record and on
+    records.thread_exceptions, instead of vanishing to stderr."""
+    from karpenter_tpu import logging as klog
+
+    hook_before = threading.excepthook
+    with klog.capture(level="error") as records:
+        assert threading.excepthook is not hook_before  # hook installed
+
+        def boom():
+            raise ValueError("conn thread died")
+
+        t = threading.Thread(target=boom, name="conn-7")
+        t.start()
+        t.join(timeout=10)
+    assert [e["exc_type"] for e in records.thread_exceptions] == ["ValueError"]
+    rec = next(r for r in records if r["logger"] == "karpenter.threading")
+    assert rec["level"] == "error"
+    assert "ValueError: conn thread died" in rec["error"]
+    assert rec["thread"] == "conn-7"
+    assert threading.excepthook is hook_before  # restored after the block
+
+
+def test_capture_chains_excepthook_into_racert_witness(witness):
+    """klog.capture() must CHAIN the previous excepthook: recording a
+    background exception for log assertions must not hide it from the
+    racert witness running the same test."""
+    from karpenter_tpu import logging as klog
+
+    with klog.capture(level="error") as records:
+
+        def boom():
+            raise OSError("handler died under capture")
+
+        t = threading.Thread(target=boom, name="conn-9")
+        t.start()
+        t.join(timeout=10)
+    assert [e["exc_type"] for e in records.thread_exceptions] == ["OSError"]
+    assert [e["exc_type"] for e in witness.thread_exceptions] == ["OSError"]
+
+
+@pytest.mark.racert
+def test_racert_marker_fixture_instruments_this_test():
+    """The conftest fixture turns instrumentation on for racert-marked
+    (and all faults-marked) tests."""
+    assert racert.current() is not None
+    lock = threading.Lock()
+    assert isinstance(lock, racert._InstrumentedLock)
+
+
+# ---------------------------------------------------------------------------
+# CLI + full tree
+
+
+def test_cli_race_clean_on_real_tree(capsys):
+    """THE acceptance gate: the race tier runs clean on the tree —
+    findings either fixed or baselined with justifications."""
+    rc = graftlint_main(["--root", REPO_ROOT, "--race"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 findings" in out
+
+
+def test_full_tree_report_has_no_stale_or_unjustified_entries():
+    report = run_race_analysis(REPO_ROOT)
+    assert report["errors"] == []
+    assert [f.render() for f in report["findings"]] == []
+    assert report["stale"] == []
+    assert report["unjustified"] == []
+
+
+def test_cli_race_json_mode(capsys):
+    assert graftlint_main(["--root", REPO_ROOT, "--race", "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["findings"] == []
+    assert set(data) >= {"findings", "stale_baseline", "errors", "baselined"}
+
+
+def test_cli_race_exits_1_on_seeded_violation(tmp_path, capsys):
+    pkg = tmp_path / "karpenter_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            import time
+
+            class Blocky:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def hold(self):
+                    with self._lock:
+                        time.sleep(1.0)
+            """
+        )
+    )
+    rc = graftlint_main(["--root", str(tmp_path), "--race"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "race-blocking-hold" in out
+
+
+def test_cli_race_errors_dominate_findings(tmp_path, capsys):
+    """Whole-program analysis over a partial program is a broken gate:
+    a parse error exits 2 even when other files still yield findings —
+    the unparsable file could hold the other half of an inversion."""
+    pkg = tmp_path / "karpenter_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "broken.py").write_text("def broken(:\n")
+    (pkg / "bad.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            import time
+
+            class Blocky:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def hold(self):
+                    with self._lock:
+                        time.sleep(1.0)
+            """
+        )
+    )
+    rc = graftlint_main(["--root", str(tmp_path), "--race"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "race-blocking-hold" in out and "parse error" in out
+
+
+def test_cli_race_unknown_rule_exits_2(capsys):
+    rc = graftlint_main(["--root", REPO_ROOT, "--race", "--rules", "race-lock-ordr"])
+    assert rc == 2
+    assert "unknown race rule" in capsys.readouterr().err
+
+
+def test_cli_race_rejects_paths_and_changed_only(capsys):
+    """Whole-program analysis: a path subset would hide exactly the
+    cross-module inversions the tier exists for."""
+    assert graftlint_main(["--root", REPO_ROOT, "--race", "somefile.py"]) == 2
+    assert graftlint_main(["--root", REPO_ROOT, "--race", "--changed-only"]) == 2
+
+
+def test_cli_race_rejects_other_tiers_options(capsys):
+    """An explicitly passed option --race never reads must be refused,
+    not silently ignored — an operator pointing the gate at an alternate
+    budget manifest must not get a green run that never opened it."""
+    rc = graftlint_main(
+        ["--root", REPO_ROOT, "--race", "--budgets", "/nonexistent.json"]
+    )
+    assert rc == 2
+    assert "--budgets" in capsys.readouterr().err
+    rc = graftlint_main(
+        ["--root", REPO_ROOT, "--race", "--reference-root", "/elsewhere"]
+    )
+    assert rc == 2
+    assert "--reference-root" in capsys.readouterr().err
+
+
+def test_cli_race_write_baseline_preserves_justifications(tmp_path, capsys):
+    pkg = tmp_path / "karpenter_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            import time
+
+            class Blocky:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def hold(self):
+                    with self._lock:
+                        time.sleep(1.0)
+            """
+        )
+    )
+    bl = tmp_path / "graftlint.race.baseline.json"
+    assert graftlint_main(["--root", str(tmp_path), "--race", "--write-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads(bl.read_text())
+    assert len(data["entries"]) == 1
+    assert data["entries"][0]["justification"].startswith("TODO")
+    data["entries"][0]["justification"] = "curated: must survive"
+    bl.write_text(json.dumps(data))
+    assert graftlint_main(["--root", str(tmp_path), "--race", "--write-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads(bl.read_text())
+    assert data["entries"][0]["justification"] == "curated: must survive"
+    # a rule-subset rewrite would truncate out-of-scope entries: refused
+    rc = graftlint_main(
+        [
+            "--root",
+            str(tmp_path),
+            "--race",
+            "--rules",
+            "race-lock-order",
+            "--write-baseline",
+        ]
+    )
+    assert rc == 2
+
+
+def test_cli_list_rules_includes_race_tier(capsys):
+    assert graftlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RACE_RULES:
+        assert rid in out
+    assert "[race]" in out
+
+
+def test_cli_all_rejects_write_and_subset_modes(capsys):
+    assert graftlint_main(["--root", REPO_ROOT, "--all", "--write-baseline"]) == 2
+    assert graftlint_main(["--root", REPO_ROOT, "--all", "--rules", "milli-units"]) == 2
+    assert graftlint_main(["--root", REPO_ROOT, "--all", "somefile.py"]) == 2
+    # --budgets would be silently ignored (the IR tier hardcodes the
+    # default manifest under --all) — an explicitly passed option that
+    # does nothing must be refused like --baseline is
+    assert graftlint_main(["--root", REPO_ROOT, "--all", "--budgets", "x.json"]) == 2
+
+
+def test_cli_tier_flags_are_mutually_exclusive(tmp_path, capsys):
+    """Silent tier precedence is a disabled gate: `--ir --race` must not
+    go green having never run the race tier, and `--race
+    --write-budgets` must not rewrite kernel_budgets.json unasked."""
+    (tmp_path / "karpenter_tpu").mkdir()
+    (tmp_path / "karpenter_tpu" / "x.py").write_text("x = 1\n", encoding="utf-8")
+    root = str(tmp_path)
+    assert graftlint_main(["--root", root, "--ir", "--race"]) == 2
+    assert graftlint_main(["--root", root, "--race", "--write-budgets"]) == 2
+    assert graftlint_main(["--root", root, "--all", "--race"]) == 2
+    assert not (tmp_path / "kernel_budgets.json").exists()
+    assert "mutually exclusive" in capsys.readouterr().err
+
+
+def test_cli_all_forwards_reference_root(tmp_path, monkeypatch, capsys):
+    """--all must hand --reference-root to the AST tier: on a machine
+    whose reference checkout lives elsewhere, citation-check would
+    otherwise be silently vacuous inside the one-command gate."""
+    import karpenter_tpu.analysis.__main__ as cli
+
+    seen = {}
+
+    def fake_run_analysis(repo_root, reference_root=None, **kw):
+        seen["reference_root"] = reference_root
+        return {"findings": [], "stale": [], "unjustified": [], "errors": [], "total": 0}
+
+    def fake_race(repo_root, **kw):
+        return {
+            "findings": [], "stale": [], "unjustified": [], "errors": [],
+            "total": 0, "all_findings": [],
+        }
+
+    from karpenter_tpu.analysis import ir, locks
+
+    monkeypatch.setattr(cli, "run_analysis", fake_run_analysis)
+    monkeypatch.setattr(locks, "run_race_analysis", fake_race)
+    monkeypatch.setattr(
+        ir,
+        "run_ir_analysis",
+        lambda *a, **kw: {
+            "findings": [], "all_findings": [], "stale": [], "unjustified": [],
+            "budget_unjustified": [], "improvements": [], "errors": [], "measured": {},
+        },
+    )
+    (tmp_path / "karpenter_tpu").mkdir()
+    rc = graftlint_main(
+        ["--root", str(tmp_path), "--all", "--reference-root", "/elsewhere/ref"]
+    )
+    capsys.readouterr()
+    assert rc == 0
+    assert seen["reference_root"] == "/elsewhere/ref"
+
+
+def test_cli_all_preflights_gate_files(tmp_path, capsys):
+    """A trailing-comma typo in any hand-edited gate file must be the
+    documented exit-2 diagnostic from --all too, not a JSONDecodeError
+    traceback out of the first tier that loads it."""
+    (tmp_path / "karpenter_tpu").mkdir()
+    (tmp_path / "karpenter_tpu" / "x.py").write_text("x = 1\n", encoding="utf-8")
+    (tmp_path / "graftlint.race.baseline.json").write_text("{bad,}", encoding="utf-8")
+    assert graftlint_main(["--root", str(tmp_path), "--all"]) == 2
+    err = capsys.readouterr().err
+    assert "cannot parse" in err and "graftlint.race.baseline.json" in err
+
+
+def test_cli_all_text_mode_itemizes_baseline_problems(tmp_path, capsys, monkeypatch):
+    """An exit-1 --all run must name each stale/unjustified entry (with
+    its tier prefix) exactly as the single-tier modes do — an aggregate
+    count alone is not actionable in a CI log."""
+    from karpenter_tpu.analysis import ir
+
+    def fake_ir(repo_root, budgets_path=None, baseline_path=None, rule_ids=None):
+        return {
+            "findings": [],
+            "all_findings": [],
+            "stale": [],
+            "unjustified": [],
+            "budget_unjustified": ["solve_scan[relax=False].carry_bytes"],
+            "improvements": [],
+            "errors": [],
+            "measured": {},
+        }
+
+    monkeypatch.setattr(ir, "run_ir_analysis", fake_ir)
+    (tmp_path / "karpenter_tpu").mkdir()
+    (tmp_path / "karpenter_tpu" / "x.py").write_text("x = 1\n", encoding="utf-8")
+    (tmp_path / "graftlint.race.baseline.json").write_text(
+        json.dumps(
+            {
+                "entries": [
+                    {
+                        "rule": "race-lock-order",
+                        "path": "karpenter_tpu/gone.py",
+                        "text": "no longer here",
+                        "justification": "was justified once",
+                    }
+                ]
+            }
+        ),
+        encoding="utf-8",
+    )
+    rc = graftlint_main(["--root", str(tmp_path), "--all"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "[race] stale baseline entry: [race-lock-order]" in out
+    assert "unjustified budget entry: solve_scan[relax=False].carry_bytes" in out
+
+
+def test_cli_all_merges_tiers_with_worst_exit_code(capsys, monkeypatch):
+    """--all = AST + race + IR with one worst-case exit code. The IR tier
+    is stubbed here (the real trace run has its own tier-1 gate in
+    test_ir_analysis.py; tracing kernels twice per suite would double
+    that cost for no new coverage)."""
+    from karpenter_tpu.analysis import ir
+
+    def fake_ir(repo_root, budgets_path=None, baseline_path=None, rule_ids=None):
+        return {
+            "findings": [],
+            "all_findings": [],
+            "stale": [],
+            "unjustified": [],
+            "budget_unjustified": [],
+            "improvements": [],
+            "errors": [],
+            "measured": {"solve_scan[relax=False]": {}},
+        }
+
+    monkeypatch.setattr(ir, "run_ir_analysis", fake_ir)
+    rc = graftlint_main(["--root", REPO_ROOT, "--all", "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(data) == {"ast", "race", "ir", "exit_code"}
+    assert data["exit_code"] == 0
+    assert data["ast"]["findings"] == [] and data["race"]["findings"] == []
+    assert data["ir"]["exit_code"] == 0
+    # each tier's payload mirrors its single-tier --json shape
+    # (docs/static-analysis.md) — the IR extras must survive the merge
+    assert data["ir"]["improvements"] == [] and "measured" in data["ir"]
+
+    # worst-case propagation: a failing IR tier dominates clean AST/race
+    def broken_ir(repo_root, budgets_path=None, baseline_path=None, rule_ids=None):
+        out = fake_ir(repo_root)
+        out["errors"] = ["solve_scan: trace exploded"]
+        return out
+
+    monkeypatch.setattr(ir, "run_ir_analysis", broken_ir)
+    rc = graftlint_main(["--root", REPO_ROOT, "--all", "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 2 and data["exit_code"] == 2 and data["ir"]["exit_code"] == 2
+
+
+def test_every_race_rule_has_fixture_coverage_here():
+    """Adding a race rule without positive/negative fixtures fails this."""
+    assert set(RACE_RULES) == {
+        "race-lock-order",
+        "race-blocking-hold",
+        "race-unguarded-shared",
+    }
+
+
+def test_race_tier_does_not_import_jax():
+    """Both halves of the race tier must stay device-free: the static
+    gate costs seconds and the runtime witness instruments the faults
+    suite without dragging a second JAX init into it."""
+    code = (
+        "import sys; "
+        "from karpenter_tpu.analysis import locks, racert; "
+        "locks.run_race_analysis('.'); "
+        "w = racert.instrument(); racert.uninstrument(); "
+        "assert 'jax' not in sys.modules, 'race tier imported jax'; "
+        "assert 'numpy' not in sys.modules, 'race tier imported numpy'"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stderr

@@ -392,10 +392,11 @@ def test_accept_loop_survives_unexpected_handler_error(server, monkeypatch):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.settimeout(10)
         sock.connect(server.socket_path)
-        sock.sendall(MAGIC + struct.pack("<III", KIND_PING, 1, 0))
-        # handler dies; the server closes this connection (EOF, or RST when
-        # the ping bytes were still unread at close)
+        # handler dies on connect; the server closes this connection (EOF,
+        # or RST when the ping bytes were still unread at close — which can
+        # land before sendall() even completes)
         try:
+            sock.sendall(MAGIC + struct.pack("<III", KIND_PING, 1, 0))
             assert sock.recv(1) == b""
         except ConnectionError:
             pass
@@ -640,6 +641,61 @@ def test_circuit_breaker_state_machine():
     assert b.allow() and b.state == "half-open"
     b.record_success()
     assert b.state == "closed" and b.allow()
+
+
+def test_circuit_breaker_recovers_from_lost_half_open_probe():
+    """A probe that never reports back (its thread killed between
+    allow() and record_*) must not wedge the breaker refusing the
+    sidecar forever: after a full cooldown with no verdict, half-open
+    re-admits exactly one fresh probe."""
+    t = {"now": 0.0}
+    b = CircuitBreaker(failure_threshold=1, cooldown_seconds=5.0, clock=lambda: t["now"])
+    b.record_failure()
+    assert b.state == "open"
+    t["now"] = 5.0
+    assert b.allow()  # probe admitted...
+    assert b.state == "half-open" and not b.allow()  # ...and is exclusive
+    # the probe vanishes without a record_*; a cooldown later the
+    # breaker hands the probe slot to a new caller instead of wedging
+    t["now"] = 10.0
+    assert b.allow()
+    assert b.state == "half-open" and not b.allow()  # still one at a time
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+
+
+def test_circuit_breaker_is_thread_safe_under_concurrent_failures():
+    """Race-tier satellite regression: the breaker is driven from every
+    concurrent request path (server handler threads, worker-pool
+    reconciles), so `consecutive_failures += 1` and the trip decision
+    must run under the breaker's lock. 8 threads x 8 failures against a
+    threshold of exactly 64: one lost update and the count comes up
+    short, the breaker never opens, and this test fails."""
+    threads_n, per_thread = 8, 8
+    b = CircuitBreaker(
+        failure_threshold=threads_n * per_thread,
+        cooldown_seconds=5.0,
+        clock=lambda: 0.0,
+    )
+    barrier = threading.Barrier(threads_n)
+
+    def hammer():
+        barrier.wait()
+        for _ in range(per_thread):
+            b.record_failure()
+
+    workers = [threading.Thread(target=hammer) for _ in range(threads_n)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join(timeout=30)
+    assert b.consecutive_failures == threads_n * per_thread, (
+        "lost update: racing record_failure() calls dropped increments"
+    )
+    assert b.state == "open" and not b.allow()
+    # reclose path stays consistent after the storm
+    b.record_success()
+    assert b.state == "closed" and b.consecutive_failures == 0 and b.allow()
 
 
 def test_remote_solve_matches_in_process_through_resilient_solver(server):
