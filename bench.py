@@ -219,6 +219,31 @@ def time_hybrid(n_pods, its, pods_fn):
     return n_pods / steady, max(0.0, first - steady), bool(s.used_tpu)
 
 
+def bench_removal_set_sweep(n_nodes: int) -> dict:
+    """Removal-set consolidation (disruption/setsweep.py): >= 1000
+    arbitrary removal sets per bounded device dispatch at the c4 shape,
+    plus the full sweep_sets search against the best-prefix strategies
+    it subsumes (docs/consolidation.md)."""
+    from karpenter_tpu.controllers.disruption.setsweep import bench_set_sweep
+
+    return bench_set_sweep(n_nodes, 100, 1024)
+
+
+def merge_detail(rows: dict) -> None:
+    """Merge bench rows into BENCH_DETAIL.json without clobbering the
+    other configs (the --consolidation section updates its row next to
+    the full --all run's)."""
+    try:
+        with open("BENCH_DETAIL.json") as f:
+            detail = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        detail = {}
+    detail.update(rows)
+    with open("BENCH_DETAIL.json", "w") as f:
+        json.dump(detail, f, indent=2)
+    log("wrote BENCH_DETAIL.json")
+
+
 def bench_consolidation_sweep(n_nodes: int) -> dict:
     """Config 4: one batched device sweep over candidate-prefix removal sets
     vs the reference's sequential binary search (multinodeconsolidation.go:116)."""
@@ -233,9 +258,21 @@ def main() -> None:
     ap.add_argument("--types", type=int, default=500)
     ap.add_argument("--all", action="store_true", help="run all BASELINE configs")
     ap.add_argument("--quick", action="store_true", help="tiny shapes (CI smoke)")
+    ap.add_argument(
+        "--consolidation",
+        action="store_true",
+        help="removal-set sweep section only (writes c8 into BENCH_DETAIL.json)",
+    )
     args = ap.parse_args()
 
     detail: dict[str, dict] = {}
+
+    if args.consolidation:
+        log("== consolidation: removal-set sweep over 2k nodes ==")
+        row = bench_removal_set_sweep(2000)
+        merge_detail({"c8_removal_set_sweep_2k": row})
+        print(json.dumps(row, indent=2))
+        return
 
     if args.quick:
         its = build_universe(144)
@@ -293,6 +330,12 @@ def main() -> None:
             detail["c4_consolidation_sweep_2k"] = bench_consolidation_sweep(2000)
         except Exception as e:  # pragma: no cover - report, don't die
             detail["c4_consolidation_sweep_2k"] = {"error": str(e)}
+
+        log("== config 8: removal-set sweep over 2k nodes ==")
+        try:
+            detail["c8_removal_set_sweep_2k"] = bench_removal_set_sweep(2000)
+        except Exception as e:  # pragma: no cover - report, don't die
+            detail["c8_removal_set_sweep_2k"] = {"error": str(e)}
 
         log("== config 7 (extra): single-node consolidation, 1k nodes ==")
         try:

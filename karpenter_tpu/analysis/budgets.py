@@ -49,6 +49,15 @@ METRIC_POLICY: dict[str, str] = {
     "first_solve_traces": "ceiling",
     "second_solve_traces": "exact",
     "second_solve_compiles": "exact",
+    # removal-set sweep accounting (analysis/ir.py
+    # setsweep_runtime_metrics): the bounded-dispatch contract — tables
+    # upload once per context, a >=1000-lane batch is ONE dispatch, a
+    # repeated same-bucket batch retraces and recompiles nothing
+    "set_table_uploads": "exact",
+    "set_pod_table_uploads": "exact",
+    "set_eval_dispatches": "exact",
+    "set_second_eval_traces": "exact",
+    "set_second_eval_compiles": "exact",
 }
 
 

@@ -77,6 +77,12 @@ _METRIC_RULE = {
     "first_solve_traces": "ir-retrace",
     "second_solve_traces": "ir-retrace",
     "second_solve_compiles": "ir-retrace",
+    # removal-set sweep accounting (setsweep_runtime_metrics)
+    "set_table_uploads": "ir-transfer",
+    "set_pod_table_uploads": "ir-transfer",
+    "set_eval_dispatches": "ir-transfer",
+    "set_second_eval_traces": "ir-retrace",
+    "set_second_eval_compiles": "ir-retrace",
 }
 
 _FORBIDDEN_EXACT = frozenset(
@@ -486,6 +492,44 @@ def _ep_sweep(kit: ProblemKit) -> tuple:
     )
 
 
+def _ep_set_sweep(kit: ProblemKit) -> tuple:
+    """The removal-set kernel at the bounded-dispatch contract shape:
+    1024 membership lanes (>= the 1000-sets-per-dispatch capability the
+    subsystem exists for) over the generic kit's union problem. Shape-
+    only — the trace never executes; the lane count pins the carry/
+    structure budget at the scale the bench demonstrates."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from karpenter_tpu.controllers.disruption import setsweep as SS
+
+    p = kit.problem
+    B, J = 1024, 8  # lanes x candidates (J padded pow2, setsweep build)
+    sizes = jnp.asarray(p.prequests_c[:1].astype(np.int32))
+    base_counts = jnp.zeros((1,), jnp.int32)
+    percand = jnp.ones((J, 1), jnp.int32)
+    member = jnp.asarray(
+        (np.arange(B)[:, None] >> np.arange(J)[None, :]) & 1, jnp.int32
+    )
+    slot_cand = jnp.asarray(
+        np.arange(p.num_existing, dtype=np.int32) % (J + 1)
+    )
+    return (
+        SS._set_sweep_kernel,
+        (
+            kit.tb,
+            kit.st,
+            kit.x_row,
+            jnp.asarray(p.eavail),
+            slot_cand,
+            member,
+            base_counts,
+            percand,
+            sizes,
+        ),
+    )
+
+
 def _ep_typeok(kit: ProblemKit) -> tuple:
     import jax.numpy as jnp
 
@@ -514,6 +558,7 @@ _KERNEL_PATH = "karpenter_tpu/solver/tpu_kernel.py"
 _RUNS_PATH = "karpenter_tpu/solver/tpu_runs.py"
 _TPU_PATH = "karpenter_tpu/solver/tpu.py"
 _SWEEP_PATH = "karpenter_tpu/controllers/disruption/sweep.py"
+_SETSWEEP_PATH = "karpenter_tpu/controllers/disruption/setsweep.py"
 
 ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint(
@@ -532,6 +577,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
     ),
     EntryPoint("_step_relax", _KERNEL_PATH, "mixed", _ep_step_relax),
     EntryPoint("_fast_sweep_kernel", _SWEEP_PATH, "generic", _ep_sweep),
+    EntryPoint("_set_sweep_kernel", _SETSWEEP_PATH, "generic", _ep_set_sweep),
     EntryPoint("_typeok_chunk", _TPU_PATH, "generic", _ep_typeok),
     EntryPoint("_gather_xs", _TPU_PATH, "generic", _ep_gather_xs),
 )
@@ -612,6 +658,65 @@ def runtime_metrics() -> dict[str, int]:
         "first_solve_traces": first_traces,
         "second_solve_traces": ev2.traces,
         "second_solve_compiles": ev2.compiles,
+    }
+
+
+def _make_set_fleet():
+    """A tiny real under-utilized fleet (5 one-rider nodes through the
+    actual control plane) — the smallest scenario that exercises the
+    removal-set subsystem end to end. Oracle-forced provisioning keeps
+    the setup JAX-compile-free; only the set sweep itself compiles."""
+    from karpenter_tpu.controllers.disruption.consolidation import (
+        MultiNodeConsolidation,
+    )
+    from karpenter_tpu.testing import fixtures
+
+    op = fixtures.underutilized_operator(
+        5, seed=7, force_oracle=True, max_ticks=120
+    )
+    mnc = MultiNodeConsolidation(
+        op.kube, op.cluster, op.cloud, op.clock, options=op.opts,
+        force_oracle=True,
+    )
+    return op, mnc.candidates()
+
+
+def setsweep_runtime_metrics() -> dict[str, int]:
+    """Entry `setsweep[runtime]`: the removal-set subsystem's transfer
+    and retrace contracts on a real (tiny) fleet — context build uploads
+    the device tables exactly once, a 1024-lane membership batch (the
+    >=1000-sets bounded-dispatch capability) is ONE device dispatch with
+    no per-set host round-trips, and a second same-bucket batch hits
+    every jit cache (0 traces, 0 compiles)."""
+    import numpy as np
+
+    from karpenter_tpu.controllers.disruption.setsweep import (
+        SetProposer,
+        SetSweepContext,
+    )
+    from karpenter_tpu.solver.tpu import TpuScheduler
+
+    op, candidates = _make_set_fleet()
+    with count_method_calls(
+        TpuScheduler, ("_tables", "_upload_pod_tables")
+    ) as uploads:
+        ctx = SetSweepContext.build(
+            op.kube, op.cluster, op.cloud, candidates, op.opts
+        )
+    proposer = SetProposer(candidates, seed=7, max_lanes=1024)
+    member = proposer._dedup(proposer._random(8 * 1024))
+    pad = np.zeros((1024, len(candidates)), bool)
+    pad[: len(member)] = member[:1024]
+    with count_method_calls(SetSweepContext, ("_dispatch",)) as calls:
+        ctx.evaluate(pad)
+    with trace_events() as ev2:
+        ctx.evaluate(pad[::-1].copy())
+    return {
+        "set_table_uploads": uploads["_tables"],
+        "set_pod_table_uploads": uploads["_upload_pod_tables"],
+        "set_eval_dispatches": calls["_dispatch"],
+        "set_second_eval_traces": ev2.traces,
+        "set_second_eval_compiles": ev2.compiles,
     }
 
 
@@ -696,6 +801,10 @@ def measure(
             measured["solve[runtime]"] = runtime_metrics()
         except Exception as e:
             errors.append(f"solve[runtime]: {type(e).__name__}: {e}")
+        try:
+            measured["setsweep[runtime]"] = setsweep_runtime_metrics()
+        except Exception as e:
+            errors.append(f"setsweep[runtime]: {type(e).__name__}: {e}")
     return measured, findings, errors
 
 
@@ -743,6 +852,7 @@ def budget_findings(
 def _entry_paths() -> dict[str, str]:
     paths = {ep.name: ep.path for ep in ENTRY_POINTS}
     paths["solve[runtime]"] = _TPU_PATH
+    paths["setsweep[runtime]"] = _SETSWEEP_PATH
     return paths
 
 

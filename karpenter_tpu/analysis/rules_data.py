@@ -22,8 +22,12 @@ from karpenter_tpu.analysis.engine import (
     iter_functions,
 )
 
-# the delta-state consolidation sweep (disruption/sweep.py module docstring)
-SWEEP_MODULES = ("karpenter_tpu/controllers/disruption/sweep.py",)
+# the delta-state consolidation sweeps (disruption/sweep.py and the
+# removal-set generalization, disruption/setsweep.py)
+SWEEP_MODULES = (
+    "karpenter_tpu/controllers/disruption/sweep.py",
+    "karpenter_tpu/controllers/disruption/setsweep.py",
+)
 
 _GUARD_BOUND_RE = re.compile(r"1\s*<<\s*3[01]|2\s*\*\*\s*3[01]|2147483647")
 

@@ -19,12 +19,18 @@ from karpenter_tpu.controllers.disruption.helpers import (
     simulate_scheduling,
 )
 from karpenter_tpu.controllers.disruption.queue import OrchestrationQueue, Validator
+from karpenter_tpu.controllers.disruption.setsweep import (
+    SetProposer,
+    SetSweepContext,
+    sweep_sets,
+)
 from karpenter_tpu.controllers.disruption.types import (
     Candidate,
     Command,
     DECISION_DELETE,
     DECISION_NOOP,
     DECISION_REPLACE,
+    command_savings,
 )
 
 __all__ = [
@@ -39,9 +45,13 @@ __all__ = [
     "EmptinessConsolidation",
     "MultiNodeConsolidation",
     "OrchestrationQueue",
+    "SetProposer",
+    "SetSweepContext",
     "SingleNodeConsolidation",
     "Validator",
     "build_budget_mapping",
     "build_candidates",
+    "command_savings",
     "simulate_scheduling",
+    "sweep_sets",
 ]

@@ -226,20 +226,41 @@ class DriftConsolidation(ConsolidationBase):
 
 
 class MultiNodeConsolidation(ConsolidationBase):
-    """multinodeconsolidation.go:51: find the LARGEST prefix of the
-    disruption-cost-sorted candidates replaceable by <= 1 new node."""
+    """multinodeconsolidation.go:51: find the best removal set among the
+    disruption-cost-sorted candidates replaceable by <= 1 new node.
 
-    def __init__(self, *args, sweep: str = "batched", **kwargs):
-        """sweep="batched" (default since round 4): ONE device invocation
-        evaluates every prefix simultaneously via the delta-state kernel
-        (disruption/sweep.py) — measured FASTER than the sequential
-        bisection at the benchmark shape (1.54s vs 2.08s, 2k nodes x 100
-        prefixes, BENCH_DETAIL c4) and identical in outcome (agree=true).
-        Shapes the sweep can't express raise SweepUnsupported and fall
-        back to sweep="binary", the reference's O(log N) bisection
-        (multinodeconsolidation.go:116)."""
+    The reference only ever searches PREFIXES of the cost order
+    (firstNConsolidationOption's binary search); the four-rung strategy
+    ladder here (docs/consolidation.md) widens that to arbitrary removal
+    sets when the tensor encoding supports it, falling back rung by rung
+    on SweepUnsupported:
+
+      sets    — bounded exhaustive search over arbitrary removal sets,
+                one batched device dispatch per proposal round
+                (disruption/setsweep.py, round 6; strictly subsumes the
+                prefix sweep and always materializes the largest
+                feasible prefix as a backstop)
+      batched — every prefix in ONE device invocation via the
+                delta-state kernel (disruption/sweep.py, round 4;
+                measured 1.35x the sequential bisection at 2k nodes x
+                100 prefixes, BENCH_DETAIL c4)
+      binary  — the reference's O(log N) bisection with full
+                simulations per probe (multinodeconsolidation.go:116)
+    Every rung materializes its result through the same
+    compute_consolidation, so prices, spot rules, and replacements are
+    byte-identical across rungs; the sequential simulator stays the
+    bit-exact referee (tests/test_setsweep.py parity matrix)."""
+
+    def __init__(self, *args, sweep: str = "sets", **kwargs):
         super().__init__(*args, **kwargs)
-        assert sweep in ("batched", "binary")
+        # sweep is env-overridable (KARPENTER_MULTINODE_SWEEP_STRATEGY);
+        # fail fast with the valid rungs, not an opaque assert (which
+        # python -O would strip into a mid-reconcile KeyError)
+        if sweep not in ("sets", "batched", "binary"):
+            raise ValueError(
+                f"unknown multi-node sweep strategy {sweep!r}; "
+                "expected one of: sets, batched, binary"
+            )
         self.sweep = sweep
 
     def compute_commands(self) -> list[Command]:
@@ -258,11 +279,12 @@ class MultiNodeConsolidation(ConsolidationBase):
                 trimmed.append(c)
         if not trimmed:
             return []
-        cmd = (
-            self.first_n_batched(trimmed)
-            if self.sweep == "batched"
-            else self.first_n_binary(trimmed)
-        )
+        search = {
+            "sets": self.first_n_sets,
+            "batched": self.first_n_batched,
+            "binary": self.first_n_binary,
+        }[self.sweep]
+        cmd = search(trimmed)
         return [cmd] if cmd.candidates else []
 
     # -- search strategies -------------------------------------------------
@@ -288,14 +310,14 @@ class MultiNodeConsolidation(ConsolidationBase):
         return best
 
     def first_n_batched(self, candidates: list[Candidate]) -> Command:
-        """The TPU-era replacement: ONE vmapped device invocation evaluates
-        the feasibility of every candidate prefix simultaneously
-        (disruption/sweep.py), then the real compute_consolidation
-        materializes the command for the largest feasible prefix — prices,
-        spot rules, and replacements byte-identical to the sequential
-        method. Shapes the sweep can't express (nodepool limits, features
-        outside the tensor encoding) fall back to a sequential
-        largest-first prefix scan, which is exact but O(N) simulations."""
+        """Rung 2: ONE device invocation evaluates the feasibility of
+        every candidate prefix simultaneously (disruption/sweep.py), then
+        the real compute_consolidation materializes the command for the
+        largest feasible prefix — prices, spot rules, and replacements
+        byte-identical to the sequential method. Shapes the sweep can't
+        express (nodepool limits, features outside the tensor encoding)
+        fall back to first_n_binary — the reference's O(log N) bisection,
+        not the old O(N) largest-first scan."""
         if not self.force_oracle:
             from karpenter_tpu.controllers.disruption.sweep import (
                 SweepUnsupported,
@@ -306,18 +328,27 @@ class MultiNodeConsolidation(ConsolidationBase):
                 return sweep_first_n(self, candidates)
             except SweepUnsupported:
                 pass
-        best = Command(reason=self.reason)
-        deadline = (
-            self.clock.now() + self.opts.multinode_consolidation_timeout_seconds
-        )
-        for k in range(len(candidates), 0, -1):
-            if self.clock.now() > deadline:
-                break
-            cmd = self.compute_consolidation(candidates[:k])
-            if cmd.candidates:
-                best = cmd
-                break
-        return best
+        return self.first_n_binary(candidates)
+
+    def first_n_sets(self, candidates: list[Candidate]) -> Command:
+        """Rung 1 (round 6): bounded exhaustive search over ARBITRARY
+        removal sets — proposal rounds, one batched device dispatch each,
+        winner materialized through compute_consolidation with the
+        largest feasible prefix as a backstop (disruption/setsweep.py).
+        Shapes the set kernel can't express fall to the prefix rungs."""
+        if not self.force_oracle:
+            from karpenter_tpu.controllers.disruption.setsweep import (
+                sweep_sets,
+            )
+            from karpenter_tpu.controllers.disruption.sweep import (
+                SweepUnsupported,
+            )
+
+            try:
+                return sweep_sets(self, candidates)
+            except SweepUnsupported:
+                pass
+        return self.first_n_batched(candidates)
 
 
 class SingleNodeConsolidation(ConsolidationBase):
@@ -368,7 +399,15 @@ class SingleNodeConsolidation(ConsolidationBase):
                 )
             except SweepUnsupported:
                 feasible = None
-        deadline = self.clock.now() + self.opts.multinode_consolidation_timeout_seconds
+        # single-node gets its OWN budget: the reference walks candidates
+        # for up to 3 minutes (singlenodeconsolidation.go:31
+        # SingleNodeConsolidationTimeoutDuration), three times the
+        # multi-node bisection's 1-minute budget
+        # (multinodeconsolidation.go:35) it used to borrow here
+        deadline = (
+            self.clock.now()
+            + self.opts.singlenode_consolidation_timeout_seconds
+        )
         for j, c in enumerate(ordered):
             if self.clock.now() > deadline:
                 break

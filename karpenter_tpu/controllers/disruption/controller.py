@@ -60,12 +60,17 @@ class DisruptionController:
         kwargs = dict(
             options=self.opts, recorder=self.recorder, force_oracle=force_oracle
         )
-        # NewMethods order (controller.go:98)
+        # NewMethods order (controller.go:98); the multi-node search
+        # enters the strategy ladder at the configured rung (sets ->
+        # batched prefixes -> binary, docs/consolidation.md) and falls
+        # down it automatically on SweepUnsupported
         self.methods = [
             EmptinessConsolidation(*args, **kwargs),
             StaticDrift(*args, **kwargs),
             DriftConsolidation(*args, **kwargs),
-            MultiNodeConsolidation(*args, **kwargs),
+            MultiNodeConsolidation(
+                *args, sweep=self.opts.multinode_sweep_strategy, **kwargs
+            ),
             SingleNodeConsolidation(*args, **kwargs),
         ]
         self.validator = Validator(

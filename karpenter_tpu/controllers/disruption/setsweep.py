@@ -1,0 +1,591 @@
+"""Removal-set consolidation: exhaustive batched search over arbitrary
+node-removal sets.
+
+The prefix sweep (disruption/sweep.py) batches only CONTIGUOUS prefixes
+of the cost-sorted candidate list — the reference's entire search space
+(multinodeconsolidation.go:116 firstNConsolidationOption binary-searches
+it with ~log2(N) sequential re-simulations under a 1-minute budget and a
+100-candidate cap, multinodeconsolidation.go:35,86). Any feasible
+removal set that is not a contiguous prefix is structurally unreachable
+there: one immovable cheap node early in the cost order shadows every
+better set behind it. This module generalizes the delta-state kernel to
+an arbitrary per-lane membership bitmask M[B, J] over the candidates:
+
+- **disabled-slot mask**: removed[b, e] = M[b, cand_of_slot[e]] — a
+  gather through the slot->candidate index (sentinel J for slots that
+  are not candidates), replacing the prefix kernel's lane-index compare;
+- **restored counts / valid pods**: counts[b] = base + M[b] @ P, where
+  P[j, c] counts candidate j's reschedulable pods of encode class c
+  (tpu_problem.group_class_counts) — a device int32 matmul replacing the
+  host-side prefix cumsum (which is the lower-triangular special case of
+  the same matrix);
+- per-lane availability: removed slots go to -1 (fit nothing), then the
+  shared class-cumsum FFD core + <=1-new-claim check
+  (sweep._ffd_feasibility_core) scores every lane at once.
+
+**int64 guard argument for non-monotone sets** (CLAUDE.md: int32 totals
+must never wrap): per-lane totals are no longer prefix-monotone, so the
+worst case is a MAX OVER MASKS rather than the longest prefix's total.
+But every per-lane count is a sum of NON-NEGATIVE per-candidate
+contributions (base >= 0, P >= 0, M in {0,1}), so each lane's counts are
+dominated elementwise by the all-candidates mask — the full-union
+totals. SetSweepContext.build therefore checks the full-membership
+worst case once, host-side in int64, before anything rides the int32
+device path; the per-class capacity-cumsum bound is lane-independent
+(removed slots only LOWER capacity), so the prefix sweep's bound carries
+over unchanged.
+
+**Search** (sweep_sets): bounded proposal->feasibility->reseed rounds
+under the existing multi-node consolidation timeout. Round 0 proposes
+every prefix (strictly subsuming the prefix sweep), per-nodepool
+prefixes, and seeded random sets; later rounds are leave-one-out /
+add-one / swap neighborhoods of the best known set plus fresh random
+sets. Every round is ONE bounded device dispatch over up to
+MAX_SET_LANES membership rows — no per-set host round-trips (the
+ir-transfer budget pins this). The winner is materialized through the
+real compute_consolidation path, so prices, spot-to-spot rules, and
+replacement construction stay byte-identical to the sequential method;
+feasible prefixes are walked largest-first as a backstop — the prefix
+sweep's own materialization rule — so the returned command's savings
+can never fall below the prefix search's.
+
+**Gates**: the set kernel supports exactly the delta-state fast shape
+(sweep.fast_gate_reason — bulk gates, no topology constraints or
+inverse groups among union pods, one requirement class) on top of the
+shared union gates (no nodepool limits, draining non-candidates,
+missing views, host ports). Anything else raises SweepUnsupported and
+MultiNodeConsolidation falls down the strategy ladder: sets -> batched
+prefixes -> binary search -> sequential oracle probes
+(docs/consolidation.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from karpenter_tpu.controllers.disruption.sweep import (
+    SweepUnsupported,
+    build_union,
+    capacity_cumsum_fits_int32,
+    fast_gate_reason,
+)
+from karpenter_tpu.controllers.disruption.types import Candidate, Command
+
+# lane cap for one device dispatch; proposals beyond it queue for the
+# next round rather than growing the compiled program unboundedly
+MAX_SET_LANES = 4096
+# proposal->feasibility->reseed rounds per sweep (each is one dispatch)
+MAX_SET_ROUNDS = 6
+# top-ranked non-prefix sets materialized through compute_consolidation
+# (the prefix backstop walk rides separately); each materialization is
+# one exact simulation
+MATERIALIZE_TRIES = 6
+# lane-count bucket floor: rounds of different sizes pad to the same
+# compiled program (pow-2 buckets, tpu_problem._pow2)
+LANE_BUCKET_FLOOR = 64
+
+_set_sweep_cached = None
+
+# bench/introspection: sweep_sets overwrites this with the last search's
+# round/lane/materialization counters (bench.py --consolidation reports
+# them next to the c4 prefix-sweep row)
+last_search_stats: dict = {}
+
+
+# graftlint: disable=dtype-overflow  (int64 worst-case guards live in SetSweepContext.build — max over masks == full membership; device math must stay int32)
+def _set_sweep_kernel(
+    tb, st, x, avail0, slot_cand, member, base_counts, percand_counts, sizes
+):
+    """The removal-set sweep: feasible[B] for membership rows member
+    [B, J] (int32 0/1). slot_cand [E] maps existing slots to candidate
+    indices (J = not a candidate); percand_counts [J, C] is the
+    per-candidate class-count matrix P; base_counts [C] counts pods
+    valid in every lane (pending pods).
+
+    The prefix kernel derives its lanes from the lane index
+    (sweep._fast_sweep_kernel); here both the disabled-slot mask and the
+    per-class valid-pod counts derive from M — a gather and a matmul —
+    and the shared core does the rest. Exactness rides the same gates
+    (fast_gate_reason) plus the caller's int64 guards."""
+    import jax.numpy as jnp
+
+    from karpenter_tpu.controllers.disruption.sweep import (
+        _ffd_feasibility_core,
+    )
+    from karpenter_tpu.solver import tpu_runs as KR
+
+    rc = KR._build_cache(tb, st, x)
+    B = member.shape[0]
+    # pad a zero column so the sentinel J gathers "never removed"
+    member_pad = jnp.concatenate(
+        [member, jnp.zeros((B, 1), member.dtype)], axis=1
+    )
+    removed = member_pad[:, slot_cand] > 0  # [B, E]
+    counts = base_counts[None, :] + member @ percand_counts  # [B, C] i32
+    avail = jnp.where(
+        removed[..., None], jnp.int32(-1), avail0[None]
+    )  # [B, E, R]
+    return _ffd_feasibility_core(tb, rc, avail, counts, sizes)
+
+
+class SetSweepContext:
+    """Built ONCE per consolidation pass: the union problem, the device
+    tables (uploaded once — CLAUDE.md: per-class tables ship once per
+    solve), and the per-candidate class-count matrix. evaluate() then
+    scores ANY batch of removal sets in one device dispatch; only the
+    [B, J] membership mask crosses the tunnel per round."""
+
+    def __init__(
+        self,
+        candidates: list[Candidate],
+        sched,
+        tb,
+        base_st,
+        x_row,
+        avail0,
+        slot_cand,
+        base_counts,
+        percand_counts,
+        sizes,
+        trivial: bool,
+    ):
+        self.candidates = candidates
+        self.sched = sched
+        self.tb = tb
+        self.base_st = base_st
+        self.x_row = x_row
+        self.avail0 = avail0
+        self.slot_cand = slot_cand
+        self.base_counts = base_counts
+        self.percand_counts = percand_counts
+        self.sizes = sizes
+        self.trivial = trivial  # no union pods: every set feasible
+        self.n_candidates = len(candidates)
+        # unknown prices ride as MAX_FLOAT (helpers.py _candidate_price);
+        # rank them as 0 — unknown is not infinitely valuable, and inf
+        # estimates would otherwise dominate every ranking they touch
+        from karpenter_tpu.cloudprovider.types import MAX_FLOAT
+
+        raw = np.array([c.price for c in candidates], np.float64)
+        self.prices = np.where(raw >= MAX_FLOAT, 0.0, raw)
+
+    @classmethod
+    def build(
+        cls, kube, cluster, cloud_provider, candidates, options=None
+    ) -> "SetSweepContext":
+        """Union gates + set-kernel gates + int64 guards + one table
+        upload. Raises SweepUnsupported when the set kernel cannot
+        express the shape (the controller falls down the ladder)."""
+        from karpenter_tpu.jaxsetup import ensure_compilation_cache
+
+        ensure_compilation_cache()
+        import jax
+        import jax.numpy as jnp
+
+        from karpenter_tpu.solver.tpu_problem import (
+            _pow2,
+            contiguous_class_seq,
+            group_class_counts,
+        )
+
+        if not candidates:
+            raise SweepUnsupported("no candidates for set sweep")
+        u = build_union(kube, cluster, cloud_provider, candidates, options)
+        p = u.problem
+        reason = fast_gate_reason(p)
+        if reason is not None:
+            # unlike the prefix path there is no vmapped full-state
+            # fallback for arbitrary sets — the lattice is too big to
+            # carry full per-lane State; fall down the ladder instead
+            raise SweepUnsupported(f"set sweep needs the fast shape: {reason}")
+
+        J = len(candidates)
+        order_arr = np.asarray(u.order, dtype=np.int64)
+        ordered_cls = p.pod_class[order_arr]
+        if len(ordered_cls) == 0:
+            return cls(
+                candidates, u.sched, u.tb, u.base, None, None, None, None,
+                None, None, trivial=True,
+            )
+        class_seq = contiguous_class_seq(ordered_cls)
+        if class_seq is None:
+            raise SweepUnsupported(
+                "encode classes not contiguous in FFD order (sig collision)"
+            )
+        pp = np.asarray(u.pod_prefix)[order_arr]
+        base, P = group_class_counts(ordered_cls, class_seq, pp, J)
+        sizes = p.prequests_c[class_seq].astype(np.int32)
+        C = len(class_seq)
+
+        # int64 guards (module docstring): counts are sums of
+        # non-negative per-candidate contributions, so the ALL-candidates
+        # mask dominates every membership row — check the full-union
+        # worst case once. The capacity cumsum is lane-independent
+        # (removed slots only lower it), so the shared base-availability
+        # bound (sweep.capacity_cumsum_fits_int32) suffices for every
+        # mask.
+        full = base + P.sum(axis=0)  # [C] int64, M = all-ones row
+        worst_tot = full @ sizes.astype(np.int64)
+        if (worst_tot >= (1 << 30)).any():
+            raise SweepUnsupported(
+                "worst-case removal-set totals exceed int32"
+            )
+        if not capacity_cumsum_fits_int32(p.eavail, sizes):
+            raise SweepUnsupported(
+                "per-class capacity cumsum exceeds int32"
+            )
+
+        # J padded to a pow-2 bucket so nearby candidate counts share one
+        # compiled program (padded candidates have zero P rows and no
+        # slots, so their membership bits are inert)
+        Jp = _pow2(J, floor=8)
+        P_pad = np.zeros((Jp, C), np.int64)
+        P_pad[:J] = P
+        slot_cand = np.full(p.num_existing, Jp, np.int32)
+        for j, c in enumerate(candidates):
+            slot_cand[u.view_slot[c.name]] = j
+
+        rep_i = p.class_reps[int(p.rclass_creps[0])]
+        xs1 = u.sched._pod_xs(p, [rep_i])
+        x_row = jax.tree_util.tree_map(lambda a: a[0], xs1)
+        return cls(
+            candidates,
+            u.sched,
+            u.tb,
+            u.base,
+            x_row,
+            jnp.asarray(p.eavail),
+            jnp.asarray(slot_cand),
+            jnp.asarray(base.astype(np.int32)),
+            jnp.asarray(P_pad.astype(np.int32)),
+            jnp.asarray(sizes),
+            trivial=False,
+        )
+
+    def evaluate(self, member: np.ndarray) -> np.ndarray:
+        """feasible[B] for a [B, J] boolean/0-1 membership batch — ONE
+        bounded device dispatch (per-set host round-trips would defeat
+        the design; the setsweep[runtime] ir-transfer budget pins the
+        dispatch count). Lane counts pad to pow-2 buckets so every round
+        size shares a compiled program."""
+        import jax
+        import jax.numpy as jnp
+
+        from karpenter_tpu.solver.tpu_problem import _pow2
+
+        member = np.asarray(member)
+        if member.ndim != 2 or member.shape[1] != self.n_candidates:
+            raise ValueError(
+                f"member must be [B, {self.n_candidates}], got {member.shape}"
+            )
+        B = member.shape[0]
+        if B == 0:
+            return np.zeros(0, bool)
+        if B > MAX_SET_LANES:
+            raise SweepUnsupported(f"{B} set lanes > {MAX_SET_LANES}")
+        if self.trivial:
+            return np.ones(B, bool)
+        Bp = _pow2(B, floor=LANE_BUCKET_FLOOR)
+        Jp = int(self.percand_counts.shape[0])
+        padded = np.zeros((Bp, Jp), np.int32)
+        padded[:B, : self.n_candidates] = member.astype(np.int32)
+        out = self._dispatch(jnp.asarray(padded))
+        return np.asarray(jax.device_get(out))[:B].astype(bool)
+
+    def _dispatch(self, member_dev):
+        """The single jitted call per proposal round (counted by the
+        ir-transfer budget)."""
+        import jax
+
+        global _set_sweep_cached
+        if _set_sweep_cached is None:
+            _set_sweep_cached = jax.jit(_set_sweep_kernel)
+        return _set_sweep_cached(
+            self.tb,
+            self.base_st,
+            self.x_row,
+            self.avail0,
+            self.slot_cand,
+            member_dev,
+            self.base_counts,
+            self.percand_counts,
+            self.sizes,
+        )
+
+    def savings_estimate(self, member: np.ndarray) -> np.ndarray:
+        """[B] — Σ removed candidate prices per lane: the materialization
+        ranking key (an upper bound on real savings; compute_consolidation
+        subtracts the replacement's price exactly)."""
+        return np.asarray(member, np.float64) @ self.prices
+
+
+class SetProposer:
+    """Bounded removal-set proposal generator. Round 0 strictly subsumes
+    the prefix sweep (every prefix is a lane) and adds per-nodepool
+    prefixes plus seeded random sets; reseed rounds explore
+    leave-one-out / add-one / swap neighborhoods of the best known set.
+    Deduplicates across rounds so the search never re-dispatches a
+    scored set."""
+
+    def __init__(
+        self, candidates: list[Candidate], seed: int = 0,
+        max_lanes: int = MAX_SET_LANES,
+    ):
+        self.J = len(candidates)
+        self.pools = [c.nodepool_name for c in candidates]
+        self.rng = np.random.default_rng(seed)
+        self.max_lanes = max_lanes
+        self._seen: set[bytes] = set()
+
+    def _dedup(self, rows: np.ndarray) -> np.ndarray:
+        out: list[np.ndarray] = []
+        for r in np.asarray(rows, bool).reshape(-1, self.J):
+            if not r.any():
+                continue  # the empty set is a no-op by definition
+            key = np.packbits(r).tobytes()
+            if key in self._seen:
+                continue
+            self._seen.add(key)
+            out.append(r)
+            if len(out) >= self.max_lanes:
+                break
+        return np.asarray(out, bool).reshape(len(out), self.J)
+
+    def _random(self, n: int) -> np.ndarray:
+        # densities spread over (0, 1): small sets and near-full sets
+        # both get sampled
+        dens = self.rng.uniform(0.1, 0.9, size=(n, 1))
+        return self.rng.random((n, self.J)) < dens
+
+    def first_round(self) -> np.ndarray:
+        J = self.J
+        rows = [np.tril(np.ones((J, J), bool))]  # lane k = candidates[:k+1]
+        for pool in sorted(set(self.pools)):
+            idx = [j for j, pl in enumerate(self.pools) if pl == pool]
+            m = np.zeros((len(idx), J), bool)
+            for k in range(len(idx)):
+                m[k, idx[: k + 1]] = True
+            rows.append(m)
+        rows.append(self._random(max(2 * J, 16)))
+        return self._dedup(np.concatenate(rows, axis=0))
+
+    def neighborhood(self, best: np.ndarray) -> np.ndarray:
+        """Local moves around the best known set, plus fresh random
+        sets so the search never stalls in a one-move basin."""
+        best = np.asarray(best, bool)
+        rows: list[np.ndarray] = []
+        members = np.flatnonzero(best)
+        outside = np.flatnonzero(~best)
+        for j in members:  # leave-one-out
+            r = best.copy()
+            r[j] = False
+            rows.append(r)
+        for j in outside:  # add-one
+            r = best.copy()
+            r[j] = True
+            rows.append(r)
+        if len(members) and len(outside):  # swaps (sampled)
+            for _ in range(min(64, len(members) * len(outside))):
+                r = best.copy()
+                r[self.rng.choice(members)] = False
+                r[self.rng.choice(outside)] = True
+                rows.append(r)
+        rows.append(self._random(max(self.J, 8)))
+        return self._dedup(
+            np.concatenate([np.atleast_2d(r) for r in rows], axis=0)
+        )
+
+
+def _prefix_len(mask: np.ndarray) -> int:
+    """k if mask is exactly candidates[:k], else 0."""
+    k = int(mask.sum())
+    return k if k and bool(mask[:k].all()) else 0
+
+
+def sweep_sets(consolidation, candidates: list[Candidate]) -> Command:
+    """MultiNodeConsolidation's sweep="sets" search: bounded
+    proposal->batched-feasibility->reseed rounds under the multi-node
+    timeout, then materialize the winners through the real
+    compute_consolidation path (feasible prefixes are walked
+    largest-first as a backstop — the prefix sweep's own rule — so the
+    result's savings are >= the prefix search's on every supported
+    shape). Raises SweepUnsupported when the set kernel cannot express
+    the problem."""
+    from karpenter_tpu.controllers.disruption.types import command_savings
+
+    ctx = SetSweepContext.build(
+        consolidation.kube,
+        consolidation.cluster,
+        consolidation.cloud,
+        candidates,
+        consolidation.opts,
+    )
+    clock = consolidation.clock
+    deadline = (
+        clock.now()
+        + consolidation.opts.multinode_consolidation_timeout_seconds
+    )
+    proposer = SetProposer(candidates, seed=len(candidates))
+    feasible_masks: list[np.ndarray] = []
+    best_mask: Optional[np.ndarray] = None
+    best_est = -1.0
+    batch = proposer.first_round()
+    rounds = 0
+    lanes = 0
+    while len(batch) and rounds < MAX_SET_ROUNDS and clock.now() <= deadline:
+        feas = ctx.evaluate(batch)
+        rounds += 1
+        lanes += len(batch)
+        ests = ctx.savings_estimate(batch)
+        improved = False
+        for r, ok, est in zip(batch, feas, ests):
+            if not ok:
+                continue
+            feasible_masks.append(r)
+            if est > best_est + 1e-12:
+                best_mask, best_est = r, float(est)
+                improved = True
+        if not improved or best_mask is None:
+            break
+        batch = proposer.neighborhood(best_mask)
+
+    # ---- materialize -----------------------------------------------------
+    # Kernel feasibility is SCHEDULABILITY; compute_consolidation also
+    # applies the price and spot-to-spot rules, so a feasible set can
+    # still materialize to a no-op (e.g. all-spot candidates whose
+    # replacement would be spot with the gate off). Two passes:
+    best_cmd = Command(reason=consolidation.reason)
+    best_savings = 0.0
+
+    # 1) prefix backstop — walk feasible prefix lengths largest-first
+    #    until one materializes, exactly the prefix sweep's rule
+    #    (sweep.sweep_first_n), so the returned command can never save
+    #    less than the prefix search's
+    feasible_ks = sorted(
+        {k for k in (_prefix_len(r) for r in feasible_masks) if k},
+        reverse=True,
+    )
+    for k in feasible_ks:
+        cmd = consolidation.compute_consolidation(candidates[:k])
+        if cmd.candidates:
+            best_cmd, best_savings = cmd, command_savings(cmd)
+            break
+
+    # 2) top non-prefix sets by estimated savings (price sum, an upper
+    #    bound that ignores replacement cost), ties toward larger sets;
+    #    prefixes are pass 1's business and must not crowd the slice
+    ranked = sorted(
+        (r for r in feasible_masks if not _prefix_len(r)),
+        key=lambda r: (-float(ctx.savings_estimate(r[None])[0]), -int(r.sum())),
+    )
+    for r in ranked[:MATERIALIZE_TRIES]:
+        if clock.now() > deadline and best_cmd.candidates:
+            break
+        subset = [c for j, c in enumerate(candidates) if r[j]]
+        cmd = consolidation.compute_consolidation(subset)
+        if not cmd.candidates:
+            continue
+        s = command_savings(cmd)
+        if s > best_savings + 1e-12 or (
+            abs(s - best_savings) <= 1e-12
+            and len(cmd.candidates) > len(best_cmd.candidates)
+        ):
+            best_cmd, best_savings = cmd, s
+
+    last_search_stats.clear()
+    last_search_stats.update(
+        rounds=rounds,
+        lanes_evaluated=lanes,
+        feasible_sets=len(feasible_masks),
+        winner_nodes=len(best_cmd.candidates),
+        winner_savings_per_hour=best_savings,
+    )
+    return best_cmd
+
+
+# ---------------------------------------------------------------------------
+# benchmark harness (bench.py --consolidation)
+
+
+def bench_set_sweep(
+    n_nodes: int = 2000, n_candidates: int = 100, lanes: int = 1024
+) -> dict:
+    """The bounded-dispatch demonstration at the c4 bench shape: >= 1000
+    removal sets over a 2k-node fleet's top candidates evaluated in ONE
+    device invocation, plus the full sweep_sets search vs the best-prefix
+    strategies it subsumes."""
+    from karpenter_tpu.controllers.disruption.consolidation import (
+        MultiNodeConsolidation,
+    )
+    from karpenter_tpu.controllers.disruption.types import command_savings
+    from karpenter_tpu.testing import fixtures
+
+    op = fixtures.underutilized_operator(
+        n_nodes, seed=7, force_oracle=False, max_ticks=400
+    )
+
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    mnc = MultiNodeConsolidation(*args, options=op.opts, force_oracle=True)
+    candidates = mnc.candidates()[:n_candidates]
+
+    # one bounded dispatch over `lanes` sets: warm (compile) then steady
+    ctx = SetSweepContext.build(op.kube, op.cluster, op.cloud, candidates, op.opts)
+    proposer = SetProposer(candidates, seed=7, max_lanes=lanes)
+    member = proposer.first_round()
+    if len(member) < lanes:
+        extra = proposer._dedup(proposer._random(4 * lanes))
+        member = np.concatenate([member, extra], axis=0)[:lanes]
+    t0 = time.monotonic()
+    feas = ctx.evaluate(member)
+    compile_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    feas = ctx.evaluate(member)
+    eval_s = time.monotonic() - t0
+
+    # the full search vs the prefix strategies it subsumes
+    mnc_sets = MultiNodeConsolidation(
+        *args, sweep="sets", options=op.opts, force_oracle=False
+    )
+    t0 = time.monotonic()
+    cmd_sets = mnc_sets.first_n_sets(candidates)
+    sets_s = time.monotonic() - t0
+    search_stats = dict(last_search_stats)
+    mnc_prefix = MultiNodeConsolidation(
+        *args, sweep="batched", options=op.opts, force_oracle=False
+    )
+    t0 = time.monotonic()
+    cmd_prefix = mnc_prefix.first_n_batched(candidates)
+    prefix_s = time.monotonic() - t0
+
+    s_sets = command_savings(cmd_sets)
+    s_prefix = command_savings(cmd_prefix)
+    return {
+        "nodes": n_nodes,
+        "candidates": len(candidates),
+        "sets_per_dispatch": int(len(member)),
+        "dispatch_seconds": round(eval_s, 3),
+        "dispatch_compile_seconds": round(max(0.0, compile_s - eval_s), 1),
+        "sets_per_second": round(len(member) / eval_s, 1) if eval_s else None,
+        "feasible_sets": int(np.asarray(feas).sum()),
+        "search_rounds": search_stats.get("rounds"),
+        "search_lanes_evaluated": search_stats.get("lanes_evaluated"),
+        "search_feasible_sets": search_stats.get("feasible_sets"),
+        "search_seconds": round(sets_s, 3),
+        "prefix_search_seconds": round(prefix_s, 3),
+        "sets_savings_per_hour": round(s_sets, 4),
+        "best_prefix_savings_per_hour": round(s_prefix, 4),
+        "savings_ratio": round(s_sets / s_prefix, 3) if s_prefix else None,
+        "sets_command_nodes": len(cmd_sets.candidates),
+        "prefix_command_nodes": len(cmd_prefix.candidates),
+        "winner_is_prefix": bool(
+            _prefix_len(
+                np.isin(
+                    [c.name for c in candidates],
+                    [c.name for c in cmd_sets.candidates],
+                )
+            )
+        ),
+    }

@@ -77,51 +77,27 @@ class SweepUnsupported(Exception):
 _fast_sweep_cached = None
 
 
-# graftlint: disable=dtype-overflow  (int64 worst-case guards live in the caller, _fast_prefix_feasibility; device math must stay int32)
-def _fast_sweep_kernel(tb, st, x, avail0, cand_idx, counts, sizes, singleton=False):
-    """The delta-state consolidation sweep (module docstring §fast path).
+# graftlint: disable=dtype-overflow  (int64 worst-case guards live in the callers — _fast_prefix_feasibility and setsweep.SetSweepContext.build; device math must stay int32)
+def _ffd_feasibility_core(tb, rc, avail, counts, sizes):
+    """Shared device body of every delta-state sweep kernel: given
+    per-lane availability `avail` [B, E, R] (-1 marks a removed slot) and
+    per-lane valid-pod counts `counts` [B, C] over the contiguous class
+    sequence (sizes [C, R]), run the class-cumsum FFD identity and the
+    <=1-new-claim check, returning feasible [B].
 
-    Key identity: FFD of a CLASS-GROUPED pod sequence with capacity-only
-    constraints is not a sequential per-pod scan — pods of one class are
-    identical, so first-fit over the ordered node list means "node e takes
-    min(remaining, cap_e)" where cap_e is the node's pod-unit capacity:
-    one masked cumsum per class. The whole 100-prefix sweep is then C
-    (≈ number of classes) scan steps over [B, E] tensors instead of
-    ~|pods| while-loop iterations per vmap lane carrying full State.
-
-    Exactness relies on the caller's gates: bulk gates hold (pairwise type
-    screens exact, offerings decompose, no minValues/limits), no union pod
-    owns or is inversely selected by any topology constraint, and all
-    union pods share one requirement class (so the static screens ok_e /
-    ok_t / final_t from the run kernel's _build_cache apply to every
-    class, and a single open claim stays compatible with every leftover
-    pod — scheduler.go:488's existing→claim→new order reduces to
-    "leftovers after existing nodes must fit the first workable template").
-    """
+    How the lanes were derived is the caller's business: the prefix
+    kernel below compares candidate indices against the lane index, the
+    removal-set kernel (setsweep.py _set_sweep_kernel) gathers a
+    membership bitmask — both collapse to the same [B, E, R] / [B, C]
+    interface, so this core is the single exactness surface the parity
+    matrices pin."""
     import jax
     import jax.numpy as jnp
 
     from karpenter_tpu.solver import tpu_kernel as K
-    from karpenter_tpu.solver import tpu_runs as KR
 
-    rc = KR._build_cache(tb, st, x)
     B, C = counts.shape
     INF = jnp.int32(1 << 30)
-    karr = jnp.arange(B, dtype=jnp.int32)
-    # per-lane availability: removed candidate slots fit nothing (-1).
-    # prefix mode: lane k removes candidates[:k+1]; singleton mode
-    # (single-node consolidation, round 5): lane k removes ONLY
-    # candidates[k] — the lanes are fully independent simulations
-    removed = (
-        cand_idx[None, :] == karr[:, None]
-        if singleton
-        else cand_idx[None, :] <= karr[:, None]
-    )
-    avail = jnp.where(
-        removed[..., None],
-        jnp.int32(-1),
-        avail0[None],
-    )  # [B, E, R]
     ok_e = rc.ok_e  # [E] — static screen, same for every class (one rclass)
 
     def body(avail, c):
@@ -144,7 +120,7 @@ def _fast_sweep_kernel(tb, st, x, avail0, cand_idx, counts, sizes, singleton=Fal
     tot = (left[:, :, None] * sizes[None]).sum(axis=1)  # [B, R]
     any_left = left.sum(axis=1) > 0
 
-    # ≤1 new claim: the first leftover pod opens a claim on the FIRST
+    # <=1 new claim: the first leftover pod opens a claim on the FIRST
     # template that can host it (scheduler.go:587 template order); all
     # remaining leftovers must then fit that same claim — one type must
     # accommodate the full leftover total plus daemon overhead.
@@ -171,6 +147,90 @@ def _fast_sweep_kernel(tb, st, x, avail0, cand_idx, counts, sizes, singleton=Fal
     return jnp.where(any_left, claim_ok, True)
 
 
+# graftlint: disable=dtype-overflow  (int64 worst-case guards live in the caller, _fast_prefix_feasibility; device math must stay int32)
+def _fast_sweep_kernel(tb, st, x, avail0, cand_idx, counts, sizes, singleton=False):
+    """The delta-state consolidation sweep (module docstring §fast path).
+
+    Key identity: FFD of a CLASS-GROUPED pod sequence with capacity-only
+    constraints is not a sequential per-pod scan — pods of one class are
+    identical, so first-fit over the ordered node list means "node e takes
+    min(remaining, cap_e)" where cap_e is the node's pod-unit capacity:
+    one masked cumsum per class. The whole 100-prefix sweep is then C
+    (≈ number of classes) scan steps over [B, E] tensors instead of
+    ~|pods| while-loop iterations per vmap lane carrying full State.
+
+    Exactness relies on the caller's gates: bulk gates hold (pairwise type
+    screens exact, offerings decompose, no minValues/limits), no union pod
+    owns or is inversely selected by any topology constraint, and all
+    union pods share one requirement class (so the static screens ok_e /
+    ok_t / final_t from the run kernel's _build_cache apply to every
+    class, and a single open claim stays compatible with every leftover
+    pod — scheduler.go:488's existing→claim→new order reduces to
+    "leftovers after existing nodes must fit the first workable template").
+    """
+    import jax.numpy as jnp
+
+    from karpenter_tpu.solver import tpu_runs as KR
+
+    rc = KR._build_cache(tb, st, x)
+    B = counts.shape[0]
+    karr = jnp.arange(B, dtype=jnp.int32)
+    # per-lane availability: removed candidate slots fit nothing (-1).
+    # prefix mode: lane k removes candidates[:k+1]; singleton mode
+    # (single-node consolidation, round 5): lane k removes ONLY
+    # candidates[k] — the lanes are fully independent simulations
+    removed = (
+        cand_idx[None, :] == karr[:, None]
+        if singleton
+        else cand_idx[None, :] <= karr[:, None]
+    )
+    avail = jnp.where(
+        removed[..., None],
+        jnp.int32(-1),
+        avail0[None],
+    )  # [B, E, R]
+    return _ffd_feasibility_core(tb, rc, avail, counts, sizes)
+
+
+def capacity_cumsum_fits_int32(eavail, sizes) -> bool:
+    """Host-side int64 proof that the delta-state kernels' per-class
+    capacity cumsum cannot wrap int32. The worst case is the BASE
+    availability divided by the class size — removed slots only LOWER
+    availability, so the bound is lane-independent and shared by every
+    sweep scheme (prefix, singleton, arbitrary membership sets); one
+    copy here keeps the guard in lockstep with _ffd_feasibility_core's
+    cap derivation for both callers."""
+    avail64 = np.asarray(eavail).astype(np.int64)
+    ok_rows = (avail64 >= 0).all(axis=1)
+    for c in range(len(sizes)):
+        s = np.asarray(sizes[c]).astype(np.int64)
+        per = np.where(s > 0, avail64 // np.maximum(s, 1), 1 << 30)
+        cap0 = np.where(ok_rows, np.maximum(per.min(axis=1), 0), 0)
+        if int(cap0.sum()) >= (1 << 31):
+            return False
+    return True
+
+
+def fast_gate_reason(problem) -> Optional[str]:
+    """Why the delta-state fast shape does NOT apply to this union
+    problem (None = it does). Shared with the removal-set subsystem
+    (setsweep.py), which supports EXACTLY this shape: the prefix path
+    falls back to its vmapped full-state scan on a reason, the set path
+    raises SweepUnsupported with it."""
+    from karpenter_tpu.solver.tpu import _bulk_gates
+
+    p = problem
+    if not _bulk_gates(p):
+        return "bulk gates fail (minValues/limits/daemon host ports/type structure)"
+    if (p.ptopo_kind_c != 0).any() or p.pinv_h_c.any() or p.pown_h_c.any():
+        return "topology constraints among union pods"
+    if any(hg.inverse for hg in p.hgroups):
+        return "inverse hostname groups (anti-affinity) in union problem"
+    if len(p.rclass_creps) != 1:
+        return "union pods span multiple requirement classes"
+    return None
+
+
 def _fast_prefix_feasibility(
     sched, problem, candidates, view_slot, order, pod_prefix, tb, base_st,
     singleton=False,
@@ -184,16 +244,13 @@ def _fast_prefix_feasibility(
     import jax.numpy as jnp
 
     from karpenter_tpu.solver import tpu_kernel as K
-    from karpenter_tpu.solver.tpu import _bulk_gates
+    from karpenter_tpu.solver.tpu_problem import (
+        contiguous_class_seq,
+        group_class_counts,
+    )
 
     p = problem
-    if not _bulk_gates(p):
-        return None
-    if (p.ptopo_kind_c != 0).any() or p.pinv_h_c.any() or p.pown_h_c.any():
-        return None
-    if any(hg.inverse for hg in p.hgroups):
-        return None
-    if len(p.rclass_creps) != 1:
+    if fast_gate_reason(p) is not None:
         return None
 
     cls = p.pod_class
@@ -201,23 +258,14 @@ def _fast_prefix_feasibility(
     ordered_cls = cls[order_arr]
     if len(ordered_cls) == 0:
         return [True] * len(candidates)
-    change = np.flatnonzero(np.diff(ordered_cls))
-    class_seq = ordered_cls[np.r_[0, change + 1]]
-    if len(set(class_seq.tolist())) != len(class_seq):
+    class_seq = contiguous_class_seq(ordered_cls)
+    if class_seq is None:
         return None  # classes not contiguous in FFD order (sig collision)
 
     C = len(class_seq)
     B = len(candidates)
-    pos_of_class = {int(c): i for i, c in enumerate(class_seq)}
-    ppos = np.array([pos_of_class[int(c)] for c in ordered_cls])
     pp = np.asarray(pod_prefix)[order_arr]
-    base = np.zeros(C, np.int64)
-    M = np.zeros((B, C), np.int64)
-    for ppi, cpos in zip(pp, ppos):
-        if ppi < 0:
-            base[cpos] += 1  # pending pods: valid in every prefix
-        else:
-            M[ppi, cpos] += 1
+    base, M = group_class_counts(ordered_cls, class_seq, pp, B)
     # prefix lanes accumulate candidates[:k+1]'s pods; singleton lanes
     # carry only candidate k's
     counts = (
@@ -236,14 +284,8 @@ def _fast_prefix_feasibility(
     worst_tot = counts.max(axis=0).astype(np.int64) @ sizes.astype(np.int64)
     if (worst_tot >= (1 << 30)).any():
         return None
-    avail64 = p.eavail.astype(np.int64)
-    for c in range(C):
-        s = sizes[c].astype(np.int64)
-        per = np.where(s > 0, avail64 // np.maximum(s, 1), 1 << 30)
-        cap0 = per.min(axis=1)
-        cap0 = np.where((avail64 >= 0).all(axis=1), np.maximum(cap0, 0), 0)
-        if int(cap0.sum()) >= (1 << 31):
-            return None
+    if not capacity_cumsum_fits_int32(p.eavail, sizes):
+        return None
 
     rep_i = problem.class_reps[int(problem.rclass_creps[0])]
     xs1 = sched._pod_xs(problem, [rep_i])
@@ -267,34 +309,42 @@ def _fast_prefix_feasibility(
     return [bool(v) for v in np.asarray(jax.device_get(feasible))]
 
 
-def prefix_feasibility(
-    kube,
-    cluster,
-    cloud_provider,
-    candidates: list[Candidate],
-    options=None,
-    singleton: bool = False,
-) -> list[bool]:
-    """[len(candidates)] — feasible(k), all lanes evaluated in one device
-    call. Prefix mode (multi-node consolidation): lane k removes
-    candidates[:k+1]. Singleton mode (single-node consolidation, round
-    5): lane k removes ONLY candidates[k] — the same machinery with
-    per-candidate instead of cumulative deltas (singlenodeconsolidation
-    .go:56 loops these simulations sequentially; here they are
-    independent device lanes)."""
+class UnionSweep:
+    """One union problem shared by every batched removal scheme: all
+    candidate nodes stay existing slots, all candidates' reschedulable
+    pods (plus pending pods) are solve pods, tables uploaded once.
+    Built by build_union; consumed by prefix_feasibility here and by
+    setsweep.SetSweepContext."""
+
+    __slots__ = (
+        "sched", "problem", "pods", "pod_prefix", "order", "view_slot",
+        "tb", "base",
+    )
+
+    def __init__(self, sched, problem, pods, pod_prefix, order, view_slot,
+                 tb, base):
+        self.sched = sched
+        self.problem = problem
+        self.pods = pods
+        self.pod_prefix = pod_prefix
+        self.order = order
+        self.view_slot = view_slot
+        self.tb = tb
+        self.base = base
+
+
+def build_union(
+    kube, cluster, cloud_provider, candidates: list[Candidate], options=None
+) -> UnionSweep:
+    """Shared front half of every batched sweep: the union gates
+    (nodepool limits, draining non-candidates, missing views, host
+    ports), the union problem encode, the shared FFD order, and the
+    one-per-sweep device table upload. Raises SweepUnsupported on any
+    gate; the caller picks the lane semantics (prefix / singleton /
+    arbitrary membership sets)."""
     from karpenter_tpu.jaxsetup import ensure_compilation_cache
 
     ensure_compilation_cache()
-    import jax
-    import jax.numpy as jnp
-
-    from karpenter_tpu.solver import tpu_kernel as K
-
-    B = len(candidates)
-    if B == 0:
-        return []
-    if B > MAX_SWEEP_PREFIXES:
-        raise SweepUnsupported(f"{B} prefixes > {MAX_SWEEP_PREFIXES}")
 
     node_pools = [np_ for np_ in kube.list("NodePool") if np_.replicas is None]
     if any(np_.limits for np_ in node_pools):
@@ -376,10 +426,48 @@ def prefix_feasibility(
 
     tb = sched._tables(problem)  # also sets sched._typeok
     sched._upload_pod_tables(problem)
-    # a consolidation-feasible prefix opens at most 1 new claim; a prefix
-    # that overflows even a handful of slots is infeasible anyway
+    # a consolidation-feasible removal set opens at most 1 new claim; a
+    # set that overflows even a handful of slots is infeasible anyway
     N = 8
     base = sched._init_state(problem, N)
+    return UnionSweep(
+        sched, problem, pods, pod_prefix, order, view_slot, tb, base
+    )
+
+
+def prefix_feasibility(
+    kube,
+    cluster,
+    cloud_provider,
+    candidates: list[Candidate],
+    options=None,
+    singleton: bool = False,
+) -> list[bool]:
+    """[len(candidates)] — feasible(k), all lanes evaluated in one device
+    call. Prefix mode (multi-node consolidation): lane k removes
+    candidates[:k+1]. Singleton mode (single-node consolidation, round
+    5): lane k removes ONLY candidates[k] — the same machinery with
+    per-candidate instead of cumulative deltas (singlenodeconsolidation
+    .go:56 loops these simulations sequentially; here they are
+    independent device lanes)."""
+    from karpenter_tpu.jaxsetup import ensure_compilation_cache
+
+    ensure_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.solver import tpu_kernel as K
+
+    B = len(candidates)
+    if B == 0:
+        return []
+    if B > MAX_SWEEP_PREFIXES:
+        raise SweepUnsupported(f"{B} prefixes > {MAX_SWEEP_PREFIXES}")
+
+    u = build_union(kube, cluster, cloud_provider, candidates, options)
+    sched, problem, pods = u.sched, u.problem, u.pods
+    pod_prefix, order, view_slot = u.pod_prefix, u.order, u.view_slot
+    tb, base = u.tb, u.base
 
     # delta-state fast path: under the bulk gates the whole sweep is C
     # cumsum steps on device (see _fast_sweep_kernel); the vmapped

@@ -105,6 +105,38 @@ class Command:
         )
 
 
+def command_savings(cmd: Command) -> float:
+    """$/hour saved by executing the command: the removed candidates'
+    current offering prices minus (for replace) the cheapest launch price
+    the replacement could resolve to. consolidation.go:199 filterByPrice
+    bounds every replacement option strictly below the current total, so
+    this is positive for every non-noop command — the removal-set
+    search's ranking objective (setsweep.py), where the prefix search's
+    objective was simply the prefix length.
+
+    A candidate with an unknown price carries MAX_FLOAT
+    (helpers.py _candidate_price); such a command's savings are
+    unknowable, not infinite, so it ranks at 0.0 rather than poisoning
+    the search with inf/NaN arithmetic."""
+    import math
+
+    from karpenter_tpu.cloudprovider.types import MAX_FLOAT
+
+    if not cmd.candidates:
+        return 0.0
+    if any(c.price >= MAX_FLOAT for c in cmd.candidates):
+        return 0.0
+    saved = sum(c.price for c in cmd.candidates)
+    for claim in cmd.replacements:
+        prices = [
+            it.offerings.available().cheapest_launch_price(claim.requirements)
+            for it in claim.instance_type_options
+        ]
+        prices = [p for p in prices if p < MAX_FLOAT]
+        saved -= min(prices) if prices else MAX_FLOAT
+    return saved if math.isfinite(saved) else 0.0
+
+
 POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
 
 

@@ -63,6 +63,15 @@ class Options:
     # disruption
     disruption_poll_seconds: float = 10.0  # disruption/controller.go:69
     multinode_consolidation_timeout_seconds: float = 60.0
+    # singlenodeconsolidation.go:31 SingleNodeConsolidationTimeoutDuration:
+    # the per-candidate walk gets 3 minutes, distinct from the multi-node
+    # bisection's 1-minute budget above
+    singlenode_consolidation_timeout_seconds: float = 180.0
+    # MultiNodeConsolidation search strategy ladder entry rung:
+    # "sets" (arbitrary removal sets, disruption/setsweep.py) |
+    # "batched" (prefix sweep) | "binary" (reference bisection);
+    # unsupported shapes fall down the ladder automatically
+    multinode_sweep_strategy: str = "sets"
     # termination reconciler pool width (termination/controller.go:58-60
     # scales 100->5000 in the reference; 1 keeps the sim deterministic)
     termination_workers: int = 1
@@ -109,6 +118,12 @@ class Options:
         f("KARPENTER_TERMINATION_WORKERS", int, "termination_workers")
         f("KARPENTER_TPU_CLAIM_SLOT_DIV", int, "tpu_claim_slot_div")
         f("KARPENTER_TPU_MIN_PODS", int, "tpu_min_pods")
+        f(
+            "KARPENTER_SINGLENODE_CONSOLIDATION_TIMEOUT",
+            float,
+            "singlenode_consolidation_timeout_seconds",
+        )
+        f("KARPENTER_MULTINODE_SWEEP_STRATEGY", str, "multinode_sweep_strategy")
         f("KARPENTER_LEADER_ELECT_LEASE_PATH", str, "leader_elect_lease_path")
         f("KARPENTER_LEADER_ELECT_LEASE_SECONDS", float, "leader_elect_lease_seconds")
         f("KARPENTER_LEADER_ELECT_RENEW_SECONDS", float, "leader_elect_renew_seconds")

@@ -1100,3 +1100,65 @@ def _encode_pod_classes(
         p.rt_kind = np.zeros((1, 1, C), np.int32)
         p.rt_gid = np.zeros((1, 1, C), np.int32)
         p.rt_sel = np.zeros((1, 1, C), bool)
+
+
+# ---------------------------------------------------------------------------
+# batched-sweep hooks (controllers/disruption/{sweep,setsweep}.py)
+#
+# The delta-state consolidation kernels treat FFD of a class-grouped pod
+# sequence as one masked cumsum per encode class. That identity needs two
+# host-side ingredients this module owns (they are properties of the
+# ENCODING, not of the disruption controller): the contiguity of classes
+# in the shared FFD order, and the per-group class-count matrix every
+# batching scheme derives its per-lane valid-pod counts from.
+
+
+def contiguous_class_seq(ordered_cls: np.ndarray):
+    """Distinct encode classes in first-appearance order IF every class is
+    one contiguous run of `ordered_cls` (the pod classes permuted into the
+    shared FFD order, ordering.ffd_sort_key); None otherwise.
+
+    The delta-state sweep kernels replace the per-pod FFD scan with one
+    cumsum per class, which is only exact when the oracle would also place
+    each class's pods consecutively — a signature collision that
+    interleaves two classes in FFD order voids the identity."""
+    ordered_cls = np.asarray(ordered_cls)
+    if len(ordered_cls) == 0:
+        return np.zeros(0, dtype=np.int64)
+    change = np.flatnonzero(np.diff(ordered_cls))
+    class_seq = ordered_cls[np.r_[0, change + 1]]
+    if len(set(class_seq.tolist())) != len(class_seq):
+        return None
+    return class_seq
+
+
+def group_class_counts(
+    ordered_cls: np.ndarray,
+    class_seq: np.ndarray,
+    group: np.ndarray,
+    n_groups: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(base[C], M[n_groups, C]) int64 pod counts per (group,
+    class-position) over a class-contiguous FFD order; group[i] < 0
+    accumulates into `base` (pods valid in every lane, e.g. pending pods
+    in a consolidation sweep). Groups with no pods keep zero rows.
+
+    This is THE batching hook behind the removal-set subsystem: a lane
+    with membership row m over the groups sees base + m @ M valid pods per
+    class (setsweep.py, a device matmul), and the prefix sweep's per-lane
+    counts are base + cumsum(M, axis=0) (sweep.py) — the lower-triangular
+    special case of the same matrix. Counts stay int64 on the host; the
+    callers own the documented int32 guards before any device cast."""
+    ordered_cls = np.asarray(ordered_cls)
+    group = np.asarray(group)
+    C = len(class_seq)
+    pos_of_class = {int(c): i for i, c in enumerate(class_seq)}
+    base = np.zeros(C, np.int64)
+    M = np.zeros((n_groups, C), np.int64)
+    for g, c in zip(group, ordered_cls):
+        cpos = pos_of_class[int(c)]
+        if g < 0:
+            base[cpos] += 1
+        else:
+            M[int(g), cpos] += 1
+    return base, M

@@ -346,3 +346,46 @@ def make_underutilized_fleet(op, n_nodes: int, rider_requests=None, max_ticks=20
         rider.phase = PodPhase.RUNNING
         op.kube.create("Pod", rider)
     return op
+
+
+def underutilized_operator(
+    n_nodes: int,
+    *,
+    seed: int = 7,
+    sizes: Optional[list[int]] = None,
+    rider_requests=None,
+    seed_requests=None,
+    force_oracle: bool = True,
+    max_ticks: int = 200,
+    options=None,
+):
+    """The shared consolidation-fleet bootstrap: an Operator with a
+    default NodePool (100% disruption budget), an under-utilized fleet
+    provisioned through the real control plane, and the
+    consolidatable-condition reconcile already run. One copy serves the
+    sweep benchmarks (disruption/setsweep.py), the IR runtime budgets
+    (analysis/ir.py), and the disruption tests — the multi-step recipe
+    must not drift between them."""
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.operator import Operator as KOperator
+
+    op = KOperator(clock=FakeClock(), force_oracle=force_oracle, options=options)
+    if sizes is not None:
+        op.raw_cloud.types = construct_instance_types(sizes=sizes)
+        op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    reset_rng(seed)
+    op.kube.create(
+        "NodePool", node_pool(name="default", budgets=[Budget(nodes="100%")])
+    )
+    make_underutilized_fleet(
+        op,
+        n_nodes,
+        rider_requests=rider_requests,
+        max_ticks=max_ticks,
+        seed_requests=seed_requests,
+    )
+    op.clock.advance(30.0)
+    op.pod_events.reconcile_all()
+    op.claim_conditions.reconcile_all()
+    return op

@@ -1,0 +1,536 @@
+"""Removal-set consolidation subsystem (disruption/setsweep.py).
+
+The sequential simulator is the bit-exact referee for every proposed
+removal set: the parity matrix below checks >= 100 randomized
+(fleet, set) scenarios seeded from the KWOK generators, plus a pinned
+scenario where only a NON-PREFIX set reaches the best savings — the
+capability the prefix search (multinodeconsolidation.go:116) is
+structurally blind to. Every SweepUnsupported gate gets a crafted
+scenario asserting the gate fires AND the controller ladder (sets ->
+batched prefixes -> binary) lands on an identical exact command.
+"""
+
+from __future__ import annotations
+
+import math
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.api.objects import (
+    Budget,
+    LabelSelector,
+    PodAffinityTerm,
+    PodPhase,
+)
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.controllers.disruption import (
+    MultiNodeConsolidation,
+    SetProposer,
+    SetSweepContext,
+    command_savings,
+    simulate_scheduling,
+)
+from karpenter_tpu.controllers.disruption.consolidation import (
+    SingleNodeConsolidation,
+)
+from karpenter_tpu.controllers.disruption.sweep import SweepUnsupported
+from karpenter_tpu.controllers.disruption.types import (
+    POD_DELETION_COST_ANNOTATION,
+)
+from karpenter_tpu.controllers.kube import FakeClock
+from karpenter_tpu.controllers.operator import Operator
+from karpenter_tpu.options import Options
+from karpenter_tpu.testing import fixtures
+
+
+def _fleet_op(
+    seed: int,
+    n_nodes: int,
+    sizes: list[int],
+    rider_cpu: str = "100m",
+    seed_cpu: str = "700m",
+    options: Options | None = None,
+):
+    """An under-utilized fleet through the real control plane (oracle
+    provisioning keeps setup compile-free)."""
+    return fixtures.underutilized_operator(
+        n_nodes,
+        seed=seed,
+        sizes=sizes,
+        rider_requests={"cpu": rider_cpu, "memory": "128Mi"},
+        seed_requests={"cpu": seed_cpu, "memory": "512Mi"},
+        force_oracle=True,
+        options=options,
+    )
+
+
+def _candidates(op, **kwargs):
+    mnc = MultiNodeConsolidation(
+        op.kube, op.cluster, op.cloud, op.clock, options=op.opts,
+        force_oracle=True, **kwargs,
+    )
+    return mnc.candidates()
+
+
+def _referee(op, subset) -> bool:
+    """The sequential simulator's feasibility verdict for removing
+    `subset`: every reschedulable pod lands and at most one new claim
+    opens (price/spot rules are compute_consolidation's business, not
+    the kernel's)."""
+    sim = simulate_scheduling(
+        op.kube, op.cluster, op.cloud, subset, op.opts, force_oracle=True
+    )
+    return sim.all_pods_scheduled() and len(sim.non_empty_new_claims()) <= 1
+
+
+# ---------------------------------------------------------------------------
+# the set-parity matrix (acceptance: >= 100 randomized scenarios)
+
+
+# each entry: (rng seed, nodes, instance sizes, rider cpu, seed cpu)
+MATRIX_FLEETS = [
+    (21, 6, [2, 32], "100m", "700m"),
+    (11, 6, [2, 32], "1200m", "1500m"),
+    (3, 6, [4, 16], "700m", "900m"),
+    (7, 5, [2, 8, 32], "400m", "700m"),
+    (13, 7, [2, 16], "900m", "1100m"),
+    (17, 6, [4, 32], "1500m", "1800m"),
+]
+
+
+def test_set_parity_matrix():
+    """Every proposed removal set's kernel feasibility bit equals the
+    sequential simulator's verdict, across >= 100 randomized scenarios;
+    and wherever any prefix is feasible, the sweep="sets" command saves
+    at least as much as the best prefix command."""
+    scenarios = 0
+    savings_compared = 0
+    for seed, n, sizes, rider, seedreq in MATRIX_FLEETS:
+        op = _fleet_op(seed, n, sizes, rider_cpu=rider, seed_cpu=seedreq)
+        cands = _candidates(op)
+        assert len(cands) >= 4, (seed, len(cands))
+        ctx = SetSweepContext.build(
+            op.kube, op.cluster, op.cloud, cands, op.opts
+        )
+        proposer = SetProposer(cands, seed=seed)
+        batch = proposer.first_round()
+        extra = proposer._dedup(proposer._random(24))
+        if len(extra):
+            batch = np.concatenate([batch, extra], axis=0)
+
+        # one bounded dispatch for the whole batch — no per-set round trips
+        calls = {"n": 0}
+        orig = SetSweepContext._dispatch
+
+        def spy(self, member_dev):
+            calls["n"] += 1
+            return orig(self, member_dev)
+
+        SetSweepContext._dispatch = spy
+        try:
+            feas = ctx.evaluate(batch)
+        finally:
+            SetSweepContext._dispatch = orig
+        assert calls["n"] == 1, "a batch must be ONE device dispatch"
+
+        for row, bit in zip(batch, feas):
+            subset = [c for j, c in enumerate(cands) if row[j]]
+            want = _referee(op, subset)
+            assert bool(bit) == want, (
+                f"fleet seed={seed}: set "
+                f"{sorted(c.name for c in subset)} kernel={bool(bit)} "
+                f"referee={want}"
+            )
+            scenarios += 1
+
+        # ladder dominance: sets >= best prefix wherever a prefix works
+        args = (op.kube, op.cluster, op.cloud, op.clock)
+        cmd_sets = MultiNodeConsolidation(
+            *args, sweep="sets", options=op.opts, force_oracle=False
+        ).first_n_sets(cands)
+        cmd_prefix = MultiNodeConsolidation(
+            *args, sweep="binary", options=op.opts, force_oracle=True
+        ).first_n_binary(cands)
+        if cmd_prefix.candidates:
+            assert (
+                command_savings(cmd_sets)
+                >= command_savings(cmd_prefix) - 1e-9
+            ), (seed, command_savings(cmd_sets), command_savings(cmd_prefix))
+            savings_compared += 1
+    assert scenarios >= 100, scenarios
+    assert savings_compared >= 3, savings_compared
+
+
+# ---------------------------------------------------------------------------
+# pinned non-prefix strict win
+
+
+def _pinned_op():
+    """Three candidates where the best removal set is NOT a prefix:
+    c0 (cheap 4-cpu node, 1200m rider) sorts first by disruption cost
+    (the 16-cpu nodes' riders carry a deletion-cost annotation), yet the
+    best command removes BOTH 16-cpu nodes — their riders fit c0's
+    slack — while every prefix either includes c0 (whose rider exhausts
+    that slack, forcing a claim the spot-to-spot gate no-ops) or stops
+    at one 16-cpu node."""
+    op = Operator(clock=FakeClock(), force_oracle=True)
+    op.raw_cloud.types = construct_instance_types(sizes=[4, 16])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    fixtures.reset_rng(5)
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="default", budgets=[Budget(nodes="100%")]),
+    )
+    for i, cpu in enumerate(["2500m", "9", "9"]):
+        op.kube.create(
+            "Pod",
+            fixtures.pod(
+                name=f"seed-{i}",
+                labels={"fleet": "seed"},
+                requests={"cpu": cpu, "memory": "512Mi"},
+                pod_anti_requirements=[
+                    PodAffinityTerm(
+                        topology_key=well_known.HOSTNAME_LABEL_KEY,
+                        label_selector=LabelSelector(
+                            match_labels={"fleet": "seed"}
+                        ),
+                    )
+                ],
+            ),
+        )
+    assert op.run_until_settled(max_ticks=60, advance_seconds=2.0) < 60
+    riders = [("1200m", None), ("1", "134217728"), ("1", "134217728")]
+    for i, (cpu, cost) in enumerate(riders):
+        node_name = op.kube.get("Pod", f"seed-{i}").node_name
+        op.kube.delete("Pod", f"seed-{i}")
+        r = fixtures.pod(
+            name=f"rider-{i}",
+            labels={"fleet": "rider"},
+            requests={"cpu": cpu, "memory": "128Mi"},
+        )
+        if cost:
+            r.metadata.annotations[POD_DELETION_COST_ANNOTATION] = cost
+        r.node_name = node_name
+        r.phase = PodPhase.RUNNING
+        op.kube.create("Pod", r)
+    op.clock.advance(26.0)
+    op.pod_events.reconcile_all()
+    op.claim_conditions.reconcile_all()
+    return op
+
+
+def test_pinned_non_prefix_set_beats_every_prefix():
+    """sweep="sets" must STRICTLY beat the prefix strategies here: the
+    winning set {c1, c2} skips candidate 0 entirely, which no prefix of
+    the cost order can express."""
+    op = _pinned_op()
+    cands = _candidates(op)
+    assert len(cands) == 3
+    # cost order pins c0 = the 4-cpu node (annotation-weighted riders
+    # push the 16-cpu nodes after it)
+    assert cands[0].instance_type_name.startswith("c-4x")
+    assert cands[1].price == cands[2].price > cands[0].price
+
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    cmd_sets = MultiNodeConsolidation(
+        *args, sweep="sets", options=op.opts, force_oracle=False
+    ).first_n_sets(cands)
+    cmd_prefix = MultiNodeConsolidation(
+        *args, sweep="batched", options=op.opts, force_oracle=False
+    ).first_n_batched(cands)
+    cmd_binary = MultiNodeConsolidation(
+        *args, sweep="binary", options=op.opts, force_oracle=True
+    ).first_n_binary(cands)
+
+    # the winner is exactly the two 16-cpu nodes — a non-prefix set
+    assert sorted(c.name for c in cmd_sets.candidates) == sorted(
+        c.name for c in cands[1:]
+    )
+    assert cmd_sets.decision == "delete"
+    s_sets = command_savings(cmd_sets)
+    s_prefix = command_savings(cmd_prefix)
+    assert math.isclose(
+        s_prefix, command_savings(cmd_binary), rel_tol=1e-12
+    )
+    assert s_sets > s_prefix + 1e-6, (s_sets, s_prefix)
+    # referee agrees the winning set is feasible
+    assert _referee(op, cmd_sets.candidates)
+
+
+# ---------------------------------------------------------------------------
+# SweepUnsupported gates: each fires on a crafted scenario AND the
+# controller falls down the ladder to an exact strategy with an
+# identical command
+
+
+def _assert_ladder_identical(op, cands):
+    """sweep="sets" (whole ladder active) and the exact binary search
+    must produce the same command on the current cluster."""
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    cmd_l = MultiNodeConsolidation(
+        *args, sweep="sets", options=op.opts, force_oracle=False
+    ).first_n_sets(cands)
+    cmd_b = MultiNodeConsolidation(
+        *args, sweep="binary", options=op.opts, force_oracle=True
+    ).first_n_binary(cands)
+    assert sorted(c.name for c in cmd_l.candidates) == sorted(
+        c.name for c in cmd_b.candidates
+    )
+    assert cmd_l.decision == cmd_b.decision
+
+
+def _gate_nodepool_limits(op, cands, monkeypatch):
+    from karpenter_tpu.utils import resources as res
+
+    np_ = op.kube.list("NodePool")[0]
+    np_.limits = res.parse_list({"cpu": "1000"})
+    op.kube.update("NodePool", np_)
+    with pytest.raises(SweepUnsupported, match="nodepool limits"):
+        SetSweepContext.build(op.kube, op.cluster, op.cloud, cands, op.opts)
+
+
+def _gate_max_prefixes(op, cands, monkeypatch):
+    import karpenter_tpu.controllers.disruption.sweep as sweep_mod
+
+    monkeypatch.setattr(sweep_mod, "MAX_SWEEP_PREFIXES", 2)
+    with pytest.raises(SweepUnsupported, match="prefixes >"):
+        sweep_mod.prefix_feasibility(
+            op.kube, op.cluster, op.cloud, cands, op.opts
+        )
+
+
+def _gate_max_set_lanes(op, cands, monkeypatch):
+    ctx = SetSweepContext.build(
+        op.kube, op.cluster, op.cloud, cands, op.opts
+    )
+    import karpenter_tpu.controllers.disruption.setsweep as ss
+
+    over = np.ones((ss.MAX_SET_LANES + 1, len(cands)), bool)
+    with pytest.raises(SweepUnsupported, match="set lanes >"):
+        ctx.evaluate(over)
+
+
+def _gate_missing_candidate(op, cands, monkeypatch):
+    # a candidate whose node is not among the schedulable views (e.g. it
+    # went unready between candidate build and the sweep)
+    ghost = SimpleNamespace(
+        name="ghost-node",
+        nodepool_name="default",
+        price=1.0,
+        reschedulable_pods=[],
+    )
+    with pytest.raises(SweepUnsupported, match="missing from schedulable"):
+        SetSweepContext.build(
+            op.kube, op.cluster, op.cloud, cands + [ghost], op.opts
+        )
+
+
+def _gate_host_ports(op, cands, monkeypatch):
+    rider = next(
+        p for p in op.kube.list("Pod") if p.name.startswith("rider-")
+    )
+    rider.host_ports = [("", "TCP", 8080)]
+    op.kube.update("Pod", rider)
+    cands = _candidates(op)  # re-snapshot the mutated rider
+    with pytest.raises(SweepUnsupported, match="host ports"):
+        SetSweepContext.build(op.kube, op.cluster, op.cloud, cands, op.opts)
+
+
+def _gate_anti_affinity(op, cands, monkeypatch):
+    rider = next(
+        p for p in op.kube.list("Pod") if p.name.startswith("rider-")
+    )
+    rider.pod_anti_affinity = [
+        PodAffinityTerm(
+            topology_key=well_known.HOSTNAME_LABEL_KEY,
+            label_selector=LabelSelector(match_labels={"fleet": "rider"}),
+        )
+    ]
+    op.kube.update("Pod", rider)
+    cands = _candidates(op)  # re-snapshot the mutated rider
+    # the anti-affinity rider shows up as topology ownership / inverse
+    # hostname groups among the union pods — either way the fast-shape
+    # gate refuses it
+    with pytest.raises(SweepUnsupported, match="set sweep needs the fast shape"):
+        SetSweepContext.build(op.kube, op.cluster, op.cloud, cands, op.opts)
+
+
+def _gate_int32_overflow(op, cands, monkeypatch):
+    from karpenter_tpu.solver import tpu_problem as tp
+
+    orig = tp.group_class_counts
+
+    def inflated(ordered_cls, class_seq, group, n_groups):
+        base, M = orig(ordered_cls, class_seq, group, n_groups)
+        # sizes are pod-units (small ints): 2^28 base counts push the
+        # worst-case total past 2^30 for any non-zero size column
+        return base + (1 << 28), M
+
+    monkeypatch.setattr(tp, "group_class_counts", inflated)
+    with pytest.raises(SweepUnsupported, match="exceed int32"):
+        SetSweepContext.build(op.kube, op.cluster, op.cloud, cands, op.opts)
+
+
+GATE_CASES = {
+    "nodepool-limits": _gate_nodepool_limits,
+    "max-prefixes": _gate_max_prefixes,
+    "max-set-lanes": _gate_max_set_lanes,
+    "missing-candidate": _gate_missing_candidate,
+    "host-ports": _gate_host_ports,
+    "anti-affinity-pod": _gate_anti_affinity,
+    "int32-overflow": _gate_int32_overflow,
+}
+
+
+@pytest.mark.parametrize("case", sorted(GATE_CASES), ids=sorted(GATE_CASES))
+def test_sweep_unsupported_gate_falls_back_exact(case, monkeypatch):
+    """Each gate raises SweepUnsupported on its crafted scenario, and the
+    sets-mode controller still lands on the binary search's exact
+    command via the strategy ladder."""
+    op = _fleet_op(21, 5, [2, 32])
+    cands = _candidates(op)
+    assert len(cands) >= 4
+    GATE_CASES[case](op, cands, monkeypatch)
+    # the mutation stays live: the ladder must route around the gate
+    cands_after = _candidates(op)
+    _assert_ladder_identical(op, cands_after or cands)
+
+
+def test_no_candidates_gate():
+    op = _fleet_op(21, 5, [2, 32])
+    with pytest.raises(SweepUnsupported, match="no candidates"):
+        SetSweepContext.build(op.kube, op.cluster, op.cloud, [], op.opts)
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: the SweepUnsupported fallback inside first_n_batched must
+# be the O(log N) bisection, not the old O(N) largest-first scan
+
+
+def test_batched_fallback_is_binary_not_linear(monkeypatch):
+    import karpenter_tpu.controllers.disruption.sweep as sweep_mod
+
+    op = _fleet_op(21, 8, [2, 32])
+    cands = _candidates(op)
+    n = len(cands)
+    assert n >= 6
+
+    def boom(consolidation, candidates):
+        raise SweepUnsupported("forced for the regression test")
+
+    monkeypatch.setattr(sweep_mod, "sweep_first_n", boom)
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    mnc = MultiNodeConsolidation(
+        *args, sweep="batched", options=op.opts, force_oracle=False
+    )
+    calls = {"n": 0}
+    orig = mnc.compute_consolidation
+
+    def counting(candidates):
+        calls["n"] += 1
+        return orig(candidates)
+
+    mnc.compute_consolidation = counting
+    cmd = mnc.first_n_batched(cands)
+    # binary search: at most ceil(log2(n)) + 1 full simulations — the old
+    # largest-first scan could burn up to n
+    assert calls["n"] <= math.ceil(math.log2(n)) + 1, calls["n"]
+    ref = MultiNodeConsolidation(
+        *args, sweep="binary", options=op.opts, force_oracle=True
+    ).first_n_binary(cands)
+    assert sorted(c.name for c in cmd.candidates) == sorted(
+        c.name for c in ref.candidates
+    )
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: single-node consolidation budgets its walk with its OWN
+# timeout (singlenodeconsolidation.go:31), not the multi-node one
+
+
+def test_single_node_has_own_timeout():
+    assert Options().singlenode_consolidation_timeout_seconds == 180.0
+    assert Options().multinode_consolidation_timeout_seconds == 60.0
+
+    # an exhausted MULTI-node budget must not starve the single-node walk
+    opts = Options(multinode_consolidation_timeout_seconds=-1.0)
+    op = _fleet_op(21, 4, [2, 32], options=opts)
+    args = (op.kube, op.cluster, op.cloud, op.clock)
+    snc = SingleNodeConsolidation(
+        *args, options=opts, force_oracle=True
+    )
+    assert snc.compute_commands(), (
+        "single-node walk must run on its own 3-minute budget"
+    )
+    mnc = MultiNodeConsolidation(
+        *args, sweep="binary", options=opts, force_oracle=True
+    )
+    assert not mnc.compute_commands(), "multi-node budget is spent"
+
+    # and an exhausted SINGLE-node budget stops only the single-node walk
+    opts2 = Options(singlenode_consolidation_timeout_seconds=-1.0)
+    snc2 = SingleNodeConsolidation(*args, options=opts2, force_oracle=True)
+    assert not snc2.compute_commands()
+
+
+# ---------------------------------------------------------------------------
+# proposer mechanics (pure host-side)
+
+
+def test_set_proposer_subsumes_prefixes_and_dedups():
+    cands = [
+        SimpleNamespace(name=f"c{i}", nodepool_name="default")
+        for i in range(5)
+    ]
+    prop = SetProposer(cands, seed=1)
+    rows = prop.first_round()
+    assert rows.dtype == bool and rows.shape[1] == 5
+    # every prefix of the cost order is a lane (strict subsumption of the
+    # prefix sweep)
+    for k in range(1, 6):
+        want = np.zeros(5, bool)
+        want[:k] = True
+        assert any((r == want).all() for r in rows), k
+    # no empty set, no duplicates
+    assert all(r.any() for r in rows)
+    keys = {np.packbits(r).tobytes() for r in rows}
+    assert len(keys) == len(rows)
+    # dedup persists across rounds
+    again = prop._dedup(rows.copy())
+    assert len(again) == 0
+
+    best = rows[0]
+    hood = prop.neighborhood(best)
+    assert all(r.any() for r in hood)
+    # neighborhood never re-proposes an already-scored set
+    for r in hood:
+        assert not any((r == s).all() for s in rows)
+
+
+def test_unknown_price_and_strategy_guards():
+    """MAX_FLOAT (unknown) candidate prices rank at 0 — never inf/NaN —
+    in both the estimate and the real savings objective; and an invalid
+    sweep strategy (env-overridable) fails fast with the valid rungs."""
+    from karpenter_tpu.cloudprovider.types import MAX_FLOAT
+    from karpenter_tpu.controllers.disruption.types import Command
+
+    unknown = SimpleNamespace(price=MAX_FLOAT, nodepool_name="default")
+    known = SimpleNamespace(price=1.5, nodepool_name="default")
+    cmd = Command(reason="underutilized", candidates=[unknown, known])
+    assert command_savings(cmd) == 0.0
+    assert command_savings(
+        Command(reason="underutilized", candidates=[known])
+    ) == 1.5
+
+    ctx = SetSweepContext(
+        [unknown, known], None, None, None, None, None, None, None, None,
+        None, trivial=True,
+    )
+    est = ctx.savings_estimate(np.ones((1, 2)))
+    assert est.tolist() == [1.5]  # unknown contributes 0, not inf
+
+    with pytest.raises(ValueError, match="sweep strategy"):
+        MultiNodeConsolidation(None, None, None, None, sweep="prefix")
