@@ -29,12 +29,90 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import tempfile
 import time
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# --- cold start (process start -> first completed solve) -------------------
+
+_COLD_SCRIPT = r"""
+import json, sys, time
+t0 = time.monotonic()  # process-start proxy: first line of the script
+n_pods, n_types = int(sys.argv[1]), int(sys.argv[2])
+sys.path.insert(0, ".")
+# real-backend-compile accounting lives in ONE place — analysis/ir.py
+# trace_events (compile events fire on persistent-cache hits too)
+from karpenter_tpu.analysis.ir import trace_events
+from bench import build_universe, make_problem
+from karpenter_tpu.solver.tpu import TpuScheduler
+
+its = build_universe(n_types)
+pools, ibp, pods, topo = make_problem(n_pods, its)
+with trace_events() as ev:
+    r = TpuScheduler(pools, ibp, topo).solve(pods)
+t1 = time.monotonic()
+print(json.dumps({
+    "first_solve_seconds": round(t1 - t0, 2),
+    "scheduled": sum(len(c.pods) for c in r.new_node_claims),
+    "backend_compiles": ev.backend_compiles,
+    "cache_hits": ev.cache_hits,
+}))
+"""
+
+
+def run_coldstart(n_pods: int, n_types: int, cache_dir: str) -> dict:
+    """One subprocess-fresh run: process start -> first completed solve,
+    against the given persistent-cache directory."""
+    env = dict(os.environ)
+    env["KARPENTER_COMPILATION_CACHE_DIR"] = cache_dir
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _COLD_SCRIPT, str(n_pods), str(n_types)],
+        env=env,
+        # the child imports `bench` by name; anchor it to THIS file's repo
+        # regardless of the caller's working directory
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True,
+        text=True,
+        timeout=1800,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr[-4000:])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def bench_coldstart(n_pods: int, n_types: int) -> dict:
+    """The cold-start row (ISSUE 8): the same problem measured from a
+    fresh process against (a) an empty cache — the compile wall — and
+    (b) the cache that run just populated — the warm-from-disk path the
+    AOT prewarm makes the common case. The warm run must show zero real
+    backend compiles (every compile_or_get served from disk)."""
+    with tempfile.TemporaryDirectory(prefix="ktpu-coldbench-") as cache_dir:
+        log(f"  cold run ({n_pods} pods x {n_types} types, empty cache)...")
+        cold = run_coldstart(n_pods, n_types, cache_dir)
+        log(f"    {cold['first_solve_seconds']}s, {cold['backend_compiles']} compiles")
+        log("  warm run (same cache)...")
+        warm = run_coldstart(n_pods, n_types, cache_dir)
+        log(f"    {warm['first_solve_seconds']}s, {warm['backend_compiles']} compiles")
+    return {
+        "pods": n_pods,
+        "types": n_types,
+        "cold_first_solve_seconds": cold["first_solve_seconds"],
+        "warm_first_solve_seconds": warm["first_solve_seconds"],
+        "speedup": round(
+            cold["first_solve_seconds"] / max(warm["first_solve_seconds"], 1e-9), 2
+        ),
+        "cold_backend_compiles": cold["backend_compiles"],
+        "warm_backend_compiles": warm["backend_compiles"],
+        "warm_cache_hits": warm["cache_hits"],
+    }
 
 
 def build_universe(n_types: int):
@@ -263,9 +341,30 @@ def main() -> None:
         action="store_true",
         help="removal-set sweep section only (writes c8 into BENCH_DETAIL.json)",
     )
+    ap.add_argument(
+        "--cold",
+        action="store_true",
+        help=(
+            "cold-start section only: subprocess-fresh process-start -> "
+            "first-solve, empty vs warm disk cache (writes c9 into "
+            "BENCH_DETAIL.json)"
+        ),
+    )
     args = ap.parse_args()
 
     detail: dict[str, dict] = {}
+
+    if args.cold:
+        # --quick mirrors tests/test_compilecache.py's shape (48 diverse
+        # pods, two KWOK sizes): the smallest problem that compiles the
+        # full runs-path program set, and one that stays CPU-tractable —
+        # larger diverse shapes execute minutes-slow off-chip
+        n_pods, n_types = (48, 24) if args.quick else (args.pods, args.types)
+        log(f"== cold start: process start -> first solve ({n_pods} x {n_types}) ==")
+        row = bench_coldstart(n_pods, n_types)
+        merge_detail({"c9_coldstart": row})
+        print(json.dumps(row, indent=2))
+        return
 
     if args.consolidation:
         log("== consolidation: removal-set sweep over 2k nodes ==")

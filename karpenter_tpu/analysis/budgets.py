@@ -49,6 +49,10 @@ METRIC_POLICY: dict[str, str] = {
     "first_solve_traces": "ceiling",
     "second_solve_traces": "exact",
     "second_solve_compiles": "exact",
+    # the shape-bucket contract (solver/buckets.py): a different REAL
+    # problem size in the same pow-2 bucket compiles and traces nothing
+    "same_bucket_solve_traces": "exact",
+    "same_bucket_solve_compiles": "exact",
     # removal-set sweep accounting (analysis/ir.py
     # setsweep_runtime_metrics): the bounded-dispatch contract — tables
     # upload once per context, a >=1000-lane batch is ONE dispatch, a

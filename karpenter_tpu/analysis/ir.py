@@ -77,6 +77,8 @@ _METRIC_RULE = {
     "first_solve_traces": "ir-retrace",
     "second_solve_traces": "ir-retrace",
     "second_solve_compiles": "ir-retrace",
+    "same_bucket_solve_traces": "ir-retrace",
+    "same_bucket_solve_compiles": "ir-retrace",
     # removal-set sweep accounting (setsweep_runtime_metrics)
     "set_table_uploads": "ir-transfer",
     "set_pod_table_uploads": "ir-transfer",
@@ -212,7 +214,7 @@ def kernel_metrics(jaxpr: Any) -> dict[str, int]:
 # per jaxpr trace / backend compile and NOT on cache hits — the counter
 # the retrace contract and tests/test_compilecache.py both ride)
 
-_COUNTS = {"traces": 0, "compiles": 0}
+_COUNTS = {"traces": 0, "compiles": 0, "cache_hits": 0}
 _LISTENER_INSTALLED = False
 
 
@@ -228,7 +230,12 @@ def _install_listener() -> None:
         elif name == "/jax/core/compile/backend_compile_duration":
             _COUNTS["compiles"] += 1
 
+    def _on_event(name: str, **kw: Any) -> None:
+        if name == "/jax/compilation_cache/cache_hits":
+            _COUNTS["cache_hits"] += 1
+
     jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    jax.monitoring.register_event_listener(_on_event)
     _LISTENER_INSTALLED = True
 
 
@@ -241,12 +248,20 @@ class trace_events(contextlib.AbstractContextManager):
 
     Properties read live, so mid-block checkpoints work too. There is no
     listener-unregister API in jax.monitoring — one module-level listener
-    feeds a global counter and contexts snapshot it."""
+    feeds a global counter and contexts snapshot it.
+
+    `compiles` counts the backend_compile_duration event, which fires per
+    compile_or_get_cached call — INCLUDING persistent-cache hits (the
+    event wraps the whole fetch-or-build step). `backend_compiles`
+    subtracts the cache-hit events, so it is the number of programs XLA
+    actually built: the metric the zero-compile cold-start contract pins
+    (a fresh process with a warm disk cache must show 0)."""
 
     def __enter__(self) -> "trace_events":
         _install_listener()
         self._t0 = _COUNTS["traces"]
         self._c0 = _COUNTS["compiles"]
+        self._h0 = _COUNTS["cache_hits"]
         return self
 
     def __exit__(self, *exc: Any) -> None:
@@ -259,6 +274,14 @@ class trace_events(contextlib.AbstractContextManager):
     @property
     def compiles(self) -> int:
         return _COUNTS["compiles"] - self._c0
+
+    @property
+    def cache_hits(self) -> int:
+        return _COUNTS["cache_hits"] - self._h0
+
+    @property
+    def backend_compiles(self) -> int:
+        return max(0, self.compiles - self.cache_hits)
 
 
 @contextlib.contextmanager
@@ -334,22 +357,26 @@ def _make_views(n: int = 3) -> list:
     ]
 
 
-def _make_pods(kind: str) -> list:
+def _make_pods(kind: str, n: int = 6) -> list:
     from karpenter_tpu.testing import fixtures
 
     fixtures.reset_rng(7)
     if kind == "generic":
-        return fixtures.make_generic_pods(6)
+        return fixtures.make_generic_pods(n)
     # mixed: relaxable preference pods AND plain pods in one batch — the
     # shape the one-step-instance contract is about
-    return fixtures.make_generic_pods(3) + fixtures.make_preference_pods(3)
+    return fixtures.make_generic_pods(n // 2) + fixtures.make_preference_pods(
+        n - n // 2
+    )
 
 
-def _make_sched(kind: str) -> tuple:
+def _make_sched(kind: str, n_pods: int = 6) -> tuple:
     """(TpuScheduler, pods) for one representative problem — the SINGLE
     construction both the jaxpr tier (build_kit) and the runtime
     accounting (_runtime_solve) measure, so their budgets can never
-    silently describe different problems."""
+    silently describe different problems. `n_pods` varies the REAL size
+    within a shape bucket (solver/buckets.py) for the same-bucket
+    zero-retrace contract."""
     from karpenter_tpu.cloudprovider.kwok import construct_instance_types
     from karpenter_tpu.solver.topology import Topology
     from karpenter_tpu.solver.tpu import TpuScheduler
@@ -358,7 +385,7 @@ def _make_sched(kind: str) -> tuple:
     fixtures.reset_rng(7)
     its = construct_instance_types(sizes=[2])
     pool = fixtures.node_pool(name="default")
-    pods = _make_pods(kind)
+    pods = _make_pods(kind, n_pods)
     views = _make_views()
     topo = Topology([pool], {"default": its}, pods, state_node_views=views)
     return TpuScheduler([pool], {"default": its}, topo, views), pods
@@ -367,10 +394,8 @@ def _make_sched(kind: str) -> tuple:
 @functools.lru_cache(maxsize=None)
 def build_kit(kind: str) -> ProblemKit:
     """kind: "generic" (zero-preference, existing nodes, bulkable) or
-    "mixed" (relaxable + plain pods in one batch)."""
-    from karpenter_tpu.jaxsetup import ensure_compilation_cache
-
-    ensure_compilation_cache()
+    "mixed" (relaxable + plain pods in one batch). The persistent compile
+    cache is configured by the solver package import below."""
     import jax
     import jax.numpy as jnp
 
@@ -385,10 +410,7 @@ def build_kit(kind: str) -> ProblemKit:
     order = sched._order_pods(problem)
     gates_ok = _bulk_gates(problem, strict_types=False)
     sched._bulk_flags_c = _bulk_class_flags(problem, gates_ok)
-    sched._runflags_dev = (
-        jnp.asarray(sched._bulk_flags_c),
-        jnp.asarray(sched._aff_c),
-    )
+    sched._set_runflags_dev()
     xs, idx_d, n_d = sched._pod_xs_with_idx(problem, order)
     rx = sched._run_x(xs, idx_d, n_d)
     x_row = jax.tree_util.tree_map(lambda a: a[0], xs)
@@ -634,13 +656,19 @@ def structure_findings(
 # trace-time-static contract demands zero new traces and zero compiles.
 
 
-def _runtime_solve() -> Any:
-    sched, pods = _make_sched("generic")
+def _runtime_solve(n_pods: int = 6) -> Any:
+    sched, pods = _make_sched("generic", n_pods)
     return sched.solve(pods)
 
 
 def runtime_metrics() -> dict[str, int]:
-    """The budgeted runtime measurements (entry `solve[runtime]`)."""
+    """The budgeted runtime measurements (entry `solve[runtime]`).
+
+    The same_bucket pair is the mechanical pin on the shape-bucket
+    contract (solver/buckets.py): a solve of a DIFFERENT real problem
+    size that lands in the same pow-2 bucket must hit every jit cache —
+    zero traces and zero compiles — which is exactly what makes a
+    prewarmed steady-state replica compile-free at traffic time."""
     from karpenter_tpu.solver.tpu import TpuScheduler
 
     counted = ("_tables", "_upload_pod_tables", "_pod_xs_with_idx")
@@ -651,6 +679,8 @@ def runtime_metrics() -> dict[str, int]:
         first_traces = ev1.traces
     with trace_events() as ev2:
         _runtime_solve()
+    with trace_events() as ev3:
+        _runtime_solve(n_pods=7)  # same pow-2 bucket, different real size
     return {
         "table_uploads": calls["_tables"],
         "pod_table_uploads": calls["_upload_pod_tables"],
@@ -658,6 +688,8 @@ def runtime_metrics() -> dict[str, int]:
         "first_solve_traces": first_traces,
         "second_solve_traces": ev2.traces,
         "second_solve_compiles": ev2.compiles,
+        "same_bucket_solve_traces": ev3.traces,
+        "same_bucket_solve_compiles": ev3.compiles,
     }
 
 

@@ -178,10 +178,9 @@ class SetSweepContext:
     ) -> "SetSweepContext":
         """Union gates + set-kernel gates + int64 guards + one table
         upload. Raises SweepUnsupported when the set kernel cannot
-        express the shape (the controller falls down the ladder)."""
-        from karpenter_tpu.jaxsetup import ensure_compilation_cache
-
-        ensure_compilation_cache()
+        express the shape (the controller falls down the ladder). The
+        persistent compile cache is configured by the solver package
+        import."""
         import jax
         import jax.numpy as jnp
 

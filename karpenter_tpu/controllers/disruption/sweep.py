@@ -341,11 +341,8 @@ def build_union(
     ports), the union problem encode, the shared FFD order, and the
     one-per-sweep device table upload. Raises SweepUnsupported on any
     gate; the caller picks the lane semantics (prefix / singleton /
-    arbitrary membership sets)."""
-    from karpenter_tpu.jaxsetup import ensure_compilation_cache
-
-    ensure_compilation_cache()
-
+    arbitrary membership sets). The persistent compile cache is
+    configured by the solver package import."""
     node_pools = [np_ for np_ in kube.list("NodePool") if np_.replicas is None]
     if any(np_.limits for np_ in node_pools):
         raise SweepUnsupported("nodepool limits make per-prefix state diverge")
@@ -450,9 +447,6 @@ def prefix_feasibility(
     per-candidate instead of cumulative deltas (singlenodeconsolidation
     .go:56 loops these simulations sequentially; here they are
     independent device lanes)."""
-    from karpenter_tpu.jaxsetup import ensure_compilation_cache
-
-    ensure_compilation_cache()
     import jax
     import jax.numpy as jnp
 

@@ -64,8 +64,13 @@ class Operator:
     ):
         self.clock = clock or FakeClock()
         self.opts = options or Options()
-        # persistent XLA compile cache: a restarted operator must not pay
-        # a cold compile inside a Solve (provisioner.go:366 1-min budget)
+        # operator startup is a sanctioned persistent-cache config site
+        # (with the solver package import and SolverServer.start): the
+        # import-time call latches the env seen THEN, and a main() that
+        # sets KARPENTER_COMPILATION_CACHE_DIR after importing us must
+        # still be honored — ensure re-applies on env change. A restarted
+        # operator must not pay a cold compile inside a Solve
+        # (provisioner.go:366 1-min budget).
         from karpenter_tpu.jaxsetup import ensure_compilation_cache
 
         ensure_compilation_cache()

@@ -21,6 +21,7 @@ _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "karpenter_tpu", "xla"
 )
 _configured = False
+_env_seen: Optional[str] = None
 _cache_dir: Optional[str] = None
 
 
@@ -33,13 +34,27 @@ def ensure_compilation_cache() -> Optional[str]:
     0 so even small programs (the per-solve helper jits) persist: a solve
     is a pipeline of ~10 compiled programs and every cold one counts
     against the Solve budget.
+
+    The first call now happens at solver package import; a caller that
+    sets KARPENTER_COMPILATION_CACHE_DIR *after* importing the package
+    (the set-env-in-main pattern) is still honored — the config re-applies
+    whenever the env value differs from the last one seen.
     """
-    global _configured, _cache_dir
-    if _configured:
+    global _configured, _env_seen, _cache_dir
+    raw = os.environ.get("KARPENTER_COMPILATION_CACHE_DIR")
+    if _configured and raw == _env_seen:
         return _cache_dir
     _configured = True
-    raw = os.environ.get("KARPENTER_COMPILATION_CACHE_DIR")
+    _env_seen = raw
     if raw == "":
+        if _cache_dir is not None:
+            # an earlier call enabled the cache: actually turn it off
+            try:
+                import jax
+
+                jax.config.update("jax_compilation_cache_dir", None)
+            except Exception:
+                pass
         _cache_dir = None
         return None
     cache_dir = raw or _DEFAULT_DIR

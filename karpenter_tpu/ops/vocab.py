@@ -26,6 +26,10 @@ from karpenter_tpu.scheduling.requirements import Requirement, Requirements
 WORD_BITS = 32
 # Safety bound: scaled resource values must leave headroom for one addition.
 _MAX_SCALED = 1 << 30
+# Reserved name prefix for phantom vocab keys added by shape bucketing
+# (solver/buckets.py re-exports this): real label keys are DNS-ish and
+# never start with a parenthesis, so collision is impossible.
+PAD_KEY_PREFIX = "(bucket-pad-"
 
 
 class UnsupportedProblem(Exception):
@@ -66,11 +70,28 @@ class Vocab:
 
     # -- finalizing ------------------------------------------------------
 
-    def finalize(self) -> None:
+    def finalize(self, pad_words=None, pad_keys=None) -> None:
         """Freeze: assign key ids (sorted) and value ids (sorted per key),
-        compute the flattened word layout."""
+        compute the flattened word layout.
+
+        pad_words/pad_keys (optional, solver/buckets.py ladder callables)
+        bucket the layout for compiled-shape stability: pad_words pads each
+        key's word count, pad_keys the key count. Phantom word bits are
+        semantically identical to the tail bits of a non-multiple-of-32
+        value count (never in full_mask, never set by any row); phantom
+        keys carry a reserved-prefix name, one zero word, no values, and
+        stay defined=False in every encoded row — invisible to the
+        requirement algebra (ops/kernels.py gates everything on defined)."""
         assert not self._finalized
         self.keys: list[str] = sorted(self._values)
+        if pad_keys is not None:
+            want = pad_keys(len(self.keys))
+            for i in range(want - len(self.keys)):
+                # ids are positional and phantom keys are appended after
+                # the sorted real list, so real key ids never shift
+                name = f"{PAD_KEY_PREFIX}{i})"
+                self.keys.append(name)
+                self._values[name] = set()
         self.key_index: dict[str, int] = {k: i for i, k in enumerate(self.keys)}
         self.values: list[list[str]] = [sorted(self._values[k]) for k in self.keys]
         self.value_index: list[dict[str, int]] = [
@@ -79,6 +100,8 @@ class Vocab:
         self.words_per_key: list[int] = [
             max(1, (len(vals) + WORD_BITS - 1) // WORD_BITS) for vals in self.values
         ]
+        if pad_words is not None:
+            self.words_per_key = [pad_words(w) for w in self.words_per_key]
         self.word_offset: list[int] = []
         off = 0
         for w in self.words_per_key:

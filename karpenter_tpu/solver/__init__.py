@@ -11,7 +11,20 @@ TPU solver behind a common interface.
   UnsupportedBySolver; the entry point for controllers and benchmarks. Also
   the resilient sidecar boundary: ResilientSolver + CircuitBreaker
   (docs/resilience.md failure ladder).
+- `buckets`/`aot`: pow-2 shape buckets outside jit + the ahead-of-time
+  compile pipeline that persists the bucket ladder's executables
+  (docs/compile.md).
+
+Importing the package configures the persistent XLA compilation cache
+exactly once (jaxsetup.ensure_compilation_cache) — every solver entry
+point (TpuScheduler, the sweep kernels, graftlint --ir, the service)
+reaches the device through this package, so this is THE call site; do
+not re-add per-module calls.
 """
+
+from karpenter_tpu.jaxsetup import ensure_compilation_cache
+
+ensure_compilation_cache()
 
 from karpenter_tpu.solver.hybrid import (
     CircuitBreaker,

@@ -1,0 +1,347 @@
+"""Ahead-of-time compilation of the bucket ladder (docs/compile.md).
+
+The shape buckets (solver/buckets.py) make the set of compiled programs a
+finite, enumerable artifact; this module enumerates it. For each (entry
+point, bucket rung, relax) combination it `.lower(...).compile()`s the
+jitted kernel against a representative problem padded to the rung —
+compilation WITHOUT execution — so every executable lands in the
+persistent compilation cache (jaxsetup.ensure_compilation_cache) and a
+fresh process warms from disk in seconds instead of paying the 25-57s
+compile wall (BENCH_r03-r05) at traffic time.
+
+Three consumers:
+
+- `SolverServer(prewarm=True)` runs `prewarm()` on a background thread
+  before reporting ready (solver/service.py); requests that arrive
+  mid-prewarm degrade to the oracle fallback, never an uncompiled device
+  path.
+- `bench.py --cold` measures process-start -> first-solve against a warm
+  vs cold disk cache.
+- tests/test_service_faults.py kills a prewarm mid-flight and asserts the
+  on-disk cache stays usable (every write here is temp-file + atomic
+  rename; JAX's own cache entries are written the same way).
+
+The ladder manifest (`aot_manifest.json` next to the cache) records every
+compiled combo with its bucket signature and compile seconds, so warm-
+from-disk is observable (readiness logs, tests) rather than anecdotal.
+
+The representative problems are the same families the graftlint IR tier
+budgets (analysis/ir.py): a generic zero-preference mix (compiles the
+plain step) and a mixed relaxable batch (compiles the tier ladder). A
+deployment whose workload departs from these families pays a one-time
+compile for its own shapes — which the persistent cache then holds; pass
+a workload-shaped `problem_fn` to cover it up front.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Optional
+
+from karpenter_tpu import logging as klog
+from karpenter_tpu import metrics
+from karpenter_tpu.solver import buckets
+
+MANIFEST_NAME = "aot_manifest.json"
+MANIFEST_VERSION = 1
+
+log = klog.root.named("solver.aot")
+
+PREWARM_PROGRAMS = metrics.REGISTRY.counter(
+    "karpenter_solver_prewarm_programs_total",
+    "AOT-compiled programs per entry point (solver/aot.py prewarm)",
+    ("entry",),
+)
+PREWARM_READY = metrics.REGISTRY.gauge(
+    "karpenter_solver_prewarm_ready",
+    "1 once the prewarm ladder is fully compiled (0 while compiling)",
+)
+PREWARM_SECONDS = metrics.REGISTRY.histogram(
+    "karpenter_solver_prewarm_duration_seconds",
+    "wall-clock seconds of one full prewarm ladder",
+)
+
+
+def manifest_path(cache_dir: str) -> str:
+    return os.path.join(cache_dir, MANIFEST_NAME)
+
+
+def load_manifest(cache_dir: Optional[str]) -> dict:
+    """The ladder manifest, or an empty shell when absent/corrupt (a
+    half-written manifest from a killed prewarm must read as 'nothing
+    recorded', never poison the next process)."""
+    shell = {"version": MANIFEST_VERSION, "combos": {}}
+    if not cache_dir:
+        return shell
+    try:
+        with open(manifest_path(cache_dir), encoding="utf-8") as f:
+            data = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError, OSError):
+        return shell
+    if data.get("version") != MANIFEST_VERSION:
+        return shell
+    data.setdefault("combos", {})
+    return data
+
+
+def _write_manifest(cache_dir: str, data: dict) -> None:
+    """Atomic write (temp + rename in the same directory): a kill at any
+    instant leaves either the old manifest or the new one, never a torn
+    file."""
+    fd, tmp = tempfile.mkstemp(
+        prefix=".aot_manifest.", dir=cache_dir, text=True
+    )
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+        os.replace(tmp, manifest_path(cache_dir))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _representative(kind: str, n_existing: int = 3):
+    """(sched, problem, order) for one representative family — the same
+    construction the IR tier traces (analysis/ir.py _make_sched), so the
+    prewarmed programs are the budgeted ones."""
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import (
+        TpuScheduler,
+        _bulk_class_flags,
+        _bulk_gates,
+    )
+    from karpenter_tpu.solver.tpu_problem import encode_problem
+    from karpenter_tpu.testing import fixtures
+
+    fixtures.reset_rng(7)
+    its = construct_instance_types(sizes=[2])
+    pool = fixtures.node_pool(name="default")
+    if kind == "generic":
+        pods = fixtures.make_generic_pods(6)
+    else:
+        pods = fixtures.make_generic_pods(3) + fixtures.make_preference_pods(3)
+    views = None
+    if n_existing:
+        from karpenter_tpu.api import labels as well_known
+        from karpenter_tpu.solver.nodes import StateNodeView
+
+        it = its[0]
+        views = [
+            StateNodeView(
+                name=f"aot-existing-{i}",
+                node_labels={well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a"},
+                labels={
+                    well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a",
+                    well_known.INSTANCE_TYPE_LABEL_KEY: it.name,
+                    well_known.NODEPOOL_LABEL_KEY: "default",
+                },
+                available=dict(it.allocatable()),
+                capacity=dict(it.capacity),
+                initialized=True,
+            )
+            for i in range(n_existing)
+        ]
+    topo = Topology([pool], {"default": its}, pods, state_node_views=views)
+    sched = TpuScheduler([pool], {"default": its}, topo, views)
+    problem = encode_problem(sched.oracle, pods)
+    tb = sched._tables(problem)
+    sched._upload_pod_tables(problem)
+    order = sched._order_pods(problem)
+    gates_ok = _bulk_gates(problem, strict_types=False)
+    sched._bulk_flags_c = _bulk_class_flags(problem, gates_ok)
+    sched._set_runflags_dev()
+    return sched, problem, order, tb
+
+
+def claim_rungs(P: int, claim_slot_div: int = 16) -> tuple[int, int]:
+    """(N_runs, N_scan) — the claim-slot buckets TpuScheduler.solve pairs
+    with a pod rung of P (keep in lockstep with solve()'s N formula)."""
+    runs = min(
+        buckets.bucket(max(64, (P + claim_slot_div - 1) // claim_slot_div)),
+        buckets.bucket(P),
+    )
+    scan_div = min(claim_slot_div, 4)
+    scan = min(
+        buckets.bucket(max(64, (P + scan_div - 1) // scan_div)),
+        buckets.bucket(P),
+    )
+    return runs, scan
+
+
+def prewarm(
+    max_pods: int = 1024,
+    min_pods: int = 64,
+    include_sweeps: bool = True,
+    stop: Optional[threading.Event] = None,
+    progress: Optional[Callable[[str, float], None]] = None,
+) -> dict:
+    """Compile the bucket ladder into the persistent cache; returns a
+    summary {"compiled": n, "skipped": n, "seconds": s, "combos": {...}}.
+
+    Interruption-safe: `stop` is polled between combos, every manifest
+    write is atomic, and each compiled executable was already durably
+    written by JAX's own cache before the manifest mentions it — a kill
+    at any point loses at most the in-flight combo.
+    """
+    from karpenter_tpu.jaxsetup import ensure_compilation_cache
+
+    cache_dir = ensure_compilation_cache()
+    import jax
+
+    from karpenter_tpu.solver import tpu_kernel as K
+    from karpenter_tpu.solver import tpu_runs as KR
+
+    t0 = time.monotonic()
+    manifest = load_manifest(cache_dir)
+    # combos recorded by a previous process are skippable only if they
+    # were compiled by the same jax/backend into the same cache (the
+    # manifest lives INSIDE the cache dir, so a wiped cache also wipes
+    # the record — a stale manifest over an empty cache cannot happen
+    # through normal cache resets)
+    reusable = (
+        frozenset(manifest["combos"])
+        if cache_dir
+        and manifest.get("jax") == jax.__version__
+        and manifest.get("backend") == jax.default_backend()
+        else frozenset()
+    )
+    manifest["jax"] = jax.__version__
+    manifest["backend"] = jax.default_backend()
+    combos: dict[str, dict] = manifest["combos"]
+    compiled = skipped = 0
+    PREWARM_READY.set(0.0)
+
+    def record(name: str, sig, seconds: float) -> None:
+        combos[name] = {
+            "signature": [list(x) for x in sig],
+            "seconds": round(seconds, 3),
+        }
+        if cache_dir:
+            _write_manifest(cache_dir, manifest)
+
+    def compile_combo(name: str, sig, fn) -> None:
+        nonlocal compiled, skipped
+        if stop is not None and stop.is_set():
+            raise InterruptedError("prewarm stopped")
+        if name in reusable and combos[name].get("signature") == [
+            list(x) for x in sig
+        ]:
+            # the executable is already persisted FOR THIS bucket
+            # signature: skip even the trace (a warm service restart
+            # prewarms in seconds, not minutes). A signature mismatch —
+            # code changes moved the representative shapes — recompiles.
+            skipped += 1
+            return
+        t = time.monotonic()
+        fn()
+        dt = time.monotonic() - t
+        compiled += 1
+        PREWARM_PROGRAMS.inc({"entry": name.split("@", 1)[0]})
+        record(name, sig, dt)
+        if progress is not None:
+            progress(name, dt)
+        log.info("prewarmed", entry=name, seconds=round(dt, 2))
+
+    completed = False
+    try:
+        for kind, relax in (("generic", False), ("mixed", True)):
+            sched, problem, order, tb = _representative(kind)
+            sig = buckets.signature(problem)
+            div = max(1, int(sched.opts.claim_slot_div))
+            for P in buckets.ladder(min_pods, max_pods, floor=64):
+                if stop is not None and stop.is_set():
+                    raise InterruptedError("prewarm stopped")
+                idxs = [order[0]] * P
+                # executing the gather/driver jits IS their prewarm (they
+                # run in milliseconds and land in both jit + disk caches)
+                xs, idx_d, n_d = sched._pod_xs_with_idx(problem, idxs)
+                rx = sched._run_x(xs, idx_d, n_d)
+                N_runs, N_scan = claim_rungs(P, div)
+                jnp = jax.numpy
+                st = sched._init_state(problem, N_runs)
+                name = f"solve_runs[relax={relax}]@P={P},N={N_runs}"
+                compile_combo(
+                    name,
+                    sig,
+                    lambda: KR.solve_runs.lower(
+                        tb, st, rx,
+                        jnp.zeros(N_runs, jnp.int32),
+                        jnp.zeros((), jnp.int32),
+                        jnp.int32(P),
+                        relax=relax,
+                    ).compile(),
+                )
+                st_s = sched._init_state(problem, N_scan)
+                name = f"solve_scan[relax={relax}]@P={P},N={N_scan}"
+                compile_combo(
+                    name,
+                    sig,
+                    lambda: K.solve_scan.lower(
+                        tb, st_s, xs, relax=relax
+                    ).compile(),
+                )
+        if include_sweeps:
+            _prewarm_sweeps(compile_combo)
+        completed = True
+    except InterruptedError:
+        log.warn("prewarm interrupted", compiled=compiled)
+    seconds = time.monotonic() - t0
+    PREWARM_SECONDS.observe(seconds)
+    if completed:
+        PREWARM_READY.set(1.0)
+    return {
+        "compiled": compiled,
+        "skipped": skipped,
+        "seconds": seconds,
+        "cache_dir": cache_dir,
+        "combos": combos,
+    }
+
+
+def _prewarm_sweeps(compile_combo) -> None:
+    """The consolidation kernels at the IR tier's CONTRACT shapes
+    (analysis/ir.py entry builders: the tiny representative fleet at 4 /
+    1024 lanes). This warms the kernels' structure, NOT a production
+    fleet's shapes — lane/node counts are cluster-sized and unknowable
+    ahead of time, so a disruption pass over a real fleet still pays a
+    one-time compile for its own bucket (then holds it via the
+    persistent cache). Point the service's prewarm_fn at a fleet
+    snapshot to cover it up front."""
+    import functools
+
+    import jax
+
+    from karpenter_tpu.analysis import ir
+
+    for ep_name in ("_fast_sweep_kernel", "_set_sweep_kernel"):
+        ep = next(e for e in ir.ENTRY_POINTS if e.name == ep_name)
+        kit = ir.build_kit(ep.kit)
+        fn, args = ep.build(kit)
+        static = (
+            {"static_argnames": ("singleton",)}
+            if ep_name == "_fast_sweep_kernel"
+            else {}
+        )
+        if isinstance(fn, functools.partial):
+            fn = fn.func
+            jitted = jax.jit(fn, **static)
+            compile_combo(
+                f"{ep_name}@contract",
+                (("kit", ep.kit),),
+                lambda: jitted.lower(*args, singleton=False).compile(),
+            )
+        else:
+            jitted = jax.jit(fn)
+            compile_combo(
+                f"{ep_name}@contract",
+                (("kit", ep.kit),),
+                lambda: jitted.lower(*args).compile(),
+            )

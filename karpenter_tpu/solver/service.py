@@ -487,11 +487,34 @@ class SolverServer:
     OUTSIDE it), and neither nests inside the other, so the server
     contributes no edges to the program's lock acquisition graph. The
     fault suite runs with racert-instrumented locks to witness exactly
-    that under real handler-thread interleavings."""
+    that under real handler-thread interleavings.
 
-    def __init__(self, socket_path: str, drain_seconds: float = 30.0):
+    Prewarm/readiness (docs/compile.md): with prewarm=True, start() kicks
+    a background thread that AOT-compiles the bucket ladder into the
+    persistent cache (solver/aot.py) BEFORE the server reports ready.
+    SOLVE requests that arrive mid-prewarm are served immediately but
+    degrade to the oracle fallback (force_oracle) — decision-identical,
+    never an uncompiled device path — and PONG payloads say "prewarming"
+    so orchestration readiness probes can gate traffic. The prewarm
+    thread polls the server's stop flag between combos, and every
+    on-disk artifact write is atomic, so a kill mid-prewarm can never
+    poison the cache (tests/test_service_faults.py)."""
+
+    def __init__(
+        self,
+        socket_path: str,
+        drain_seconds: float = 30.0,
+        prewarm: bool = False,
+        prewarm_fn=None,
+    ):
         self.socket_path = socket_path
         self.drain_seconds = drain_seconds
+        self.prewarm = prewarm
+        self._prewarm_fn = prewarm_fn
+        self._prewarm_thread: Optional[threading.Thread] = None
+        self._prewarm_stop: Optional[threading.Event] = None
+        self._prewarm_gen = 0
+        self.ready = threading.Event()
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -501,23 +524,93 @@ class SolverServer:
         # the read-modify-write needs its own lock or increments are lost
         self._stats_lock = threading.Lock()
         self.solves = 0
+        self.oracle_degraded_solves = 0
         self.log = klog.root.named("solver.service")
 
     def start(self) -> None:
+        # service startup is one of the two sanctioned call sites of the
+        # persistent-cache config (the other is the solver package import)
+        from karpenter_tpu.jaxsetup import ensure_compilation_cache
+
+        ensure_compilation_cache()
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
         self._stop.clear()
+        # readiness transitions BEFORE the accept loop exists: a request
+        # racing start() must never observe a ready=False non-prewarming
+        # server (it would spuriously degrade to the oracle). The gen
+        # bump rides the stats lock so the abandoned-prewarm-thread read
+        # in _run_prewarm's finally can never see a torn increment.
+        with self._stats_lock:
+            self._prewarm_gen += 1
+        if self.prewarm:
+            self.ready.clear()
+        else:
+            self.ready.set()
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.bind(self.socket_path)
         self._sock.listen(8)
         self._sock.settimeout(0.2)
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+        if self.prewarm:
+            # each prewarm thread gets its OWN stop event: start() after
+            # stop() clears the server-wide _stop, which must not revive
+            # an abandoned thread — its private event stays set, so it
+            # exits at the next combo boundary even across restarts
+            self._prewarm_stop = threading.Event()
+            self._prewarm_thread = threading.Thread(
+                target=self._run_prewarm,
+                args=(self._prewarm_gen, self._prewarm_stop),
+                daemon=True,
+            )
+            self._prewarm_thread.start()
+
+    def _run_prewarm(self, gen: int, stop: threading.Event) -> None:
+        """Compile the bucket ladder, then report ready. A prewarm failure
+        is logged and the server reports ready anyway (degraded: first
+        solves pay their compiles) — a broken cache must not brick the
+        sidecar. `gen` guards the ready transition: a thread abandoned by
+        stop() (the join is bounded; a combo compiles for ~15s) must not
+        flip readiness during a LATER start()'s prewarm."""
+        try:
+            if self._prewarm_fn is not None:
+                self._prewarm_fn(stop)
+            else:
+                from karpenter_tpu.solver import aot
+
+                out = aot.prewarm(stop=stop)
+                self.log.info(
+                    "prewarm complete",
+                    compiled=out["compiled"],
+                    skipped=out["skipped"],
+                    seconds=round(out["seconds"], 1),
+                )
+        except Exception as e:
+            self.log.error(
+                "prewarm failed; serving without it",
+                error=f"{type(e).__name__}: {e}",
+            )
+        finally:
+            with self._stats_lock:
+                current = gen == self._prewarm_gen
+            if current:
+                self.ready.set()
 
     def stop(self) -> None:
         """Graceful drain: stop accepting, let in-flight handlers finish
         (bounded by drain_seconds), then tear the socket down."""
         self._stop.set()
+        if self._prewarm_thread is not None:
+            # a combo compiles for ~15s and .compile() is uninterruptible,
+            # so the bounded join deliberately abandons the daemon thread
+            # rather than block shutdown; its private stop event (set
+            # here, never cleared) makes it exit at the next combo
+            # boundary, and the gen guard keeps its final ready-set inert
+            if self._prewarm_stop is not None:
+                self._prewarm_stop.set()
+            self._prewarm_thread.join(timeout=1.0)
+            self._prewarm_thread = None
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -618,7 +711,8 @@ class SolverServer:
             except socket.timeout as e:
                 raise ProtocolError(f"peer stalled mid-frame: {e}") from e
             if kind == KIND_PING:
-                self._send_response(conn, KIND_PONG, b"", req_id)
+                payload = b"ready" if self.ready.is_set() else b"prewarming"
+                self._send_response(conn, KIND_PONG, payload, req_id)
                 continue
             if kind != KIND_SOLVE:
                 self._send_response(
@@ -646,6 +740,12 @@ class SolverServer:
             force_oracle,
             source,
         ) = _decode_problem_request(payload)
+        # mid-prewarm requests degrade to the (decision-identical) oracle:
+        # the device path may still be compiling, and a solve must never
+        # pay the compile wall nor race the prewarm for the jit caches
+        degraded = not self.ready.is_set()
+        if degraded:
+            force_oracle = True
         results, scheduler = solve_in_process(
             node_pools,
             its_by_pool,
@@ -658,6 +758,8 @@ class SolverServer:
         )
         with self._stats_lock:
             self.solves += 1
+            if degraded:
+                self.oracle_degraded_solves += 1
         return _encode_result(results, bool(scheduler.used_tpu), pods)
 
 

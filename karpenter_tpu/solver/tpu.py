@@ -39,6 +39,7 @@ from karpenter_tpu.cloudprovider.types import InstanceTypes
 from karpenter_tpu.ops.encode import Reqs, decode_row
 from karpenter_tpu.ops.kernels import VocabArrays
 from karpenter_tpu.scheduling import Requirement, Requirements
+from karpenter_tpu.solver import buckets
 from karpenter_tpu.solver import nodes as nodes_mod
 from karpenter_tpu.solver.nodes import (
     SchedulingNodeClaim,
@@ -392,7 +393,9 @@ def _bulk_gates(p: EncodedProblem, strict_types: bool = True) -> bool:
     # is independent of which zone the tighten picks)
     zone_kid = vocab.key_index.get(well_known.TOPOLOGY_ZONE_LABEL_KEY)
     per_type: dict[int, dict[int, set]] = {}
-    for o in range(p.otype.shape[0]):
+    # bucket-padded offering rows (ovalid=False) carry sentinel words that
+    # must not perturb the per-type zone-coverage decomposition
+    for o in range(p.num_offerings_real):
         i = int(p.otype[o])
         if p.oword[o, 2] != -1:
             return False  # reservation-id offerings
@@ -473,11 +476,9 @@ class TpuScheduler:
 
     def solve(self, pods: list[Pod]) -> Results:
         """May raise UnsupportedBySolver; Solver wrappers catch and fall
-        back to the oracle."""
-        from karpenter_tpu.jaxsetup import ensure_compilation_cache
-
-        ensure_compilation_cache()
-        import jax  # deferred so encoding errors surface first
+        back to the oracle. The persistent compile cache is configured by
+        the solver package import (jaxsetup.ensure_compilation_cache)."""
+        import jax  # already imported by the package init; cheap rebind
 
         from karpenter_tpu.profiling import SolveProfile
 
@@ -519,12 +520,7 @@ class TpuScheduler:
         use_runs = bool(self._bulk_flags_c.any())
         self.last_used_runs = use_runs  # introspection for tests/bench
         if use_runs:
-            import jax.numpy as jnp
-
-            self._runflags_dev = (
-                jnp.asarray(self._bulk_flags_c),
-                jnp.asarray(self._aff_c),
-            )
+            self._set_runflags_dev()
 
         # Claim slots: most solves create far fewer claims than pods (the
         # bench mix averages ~5 pods/claim), so start small — every
@@ -665,6 +661,18 @@ class TpuScheduler:
         uids = [pod.uid for pod in pods]
         return ffd_order_cols(cpu_c[cls], mem_c[cls], sig_c[cls], ts_list, uids)
 
+    def _set_runflags_dev(self) -> None:
+        """Upload the per-class bulk/affinity flags for the run driver,
+        bucket-padded in step with the class tables (_upload_pod_tables)
+        so _run_arrays compiles per rung, not per class count."""
+        import jax.numpy as jnp
+
+        nc = int(self._dev_tables[8].shape[0])  # padded prequests_c rows
+        self._runflags_dev = (
+            jnp.asarray(buckets.pad_rows(self._bulk_flags_c, nc)),
+            jnp.asarray(buckets.pad_rows(self._aff_c, nc)),
+        )
+
     def _run_x(self, xs, idx_d, n_d):
         """Build the run-kernel driver arrays for a round — entirely on
         device from the round's already-uploaded index array (see
@@ -679,18 +687,29 @@ class TpuScheduler:
             x=xs, is_head=is_head, bulk=bulk, aff=aff, run_rem=run_rem
         )
 
+    def _cr_padded(self, p: EncodedProblem) -> np.ndarray:
+        """[NR_pad] class index per requirement class, bucket-padded by
+        repeating real rows (solver/buckets.py: pad rows are never
+        gathered — rcls_of only holds real ids — so repeats are the
+        cheapest shape-stable filler)."""
+        cr = np.asarray(p.rclass_creps, dtype=np.int64)
+        if not buckets.enabled() or len(cr) == 0:
+            return cr
+        return cr[np.arange(buckets.bucket(len(cr))) % len(cr)]
+
     def _pod_typeok(self, p: EncodedProblem, tb):
-        """[NR, IW] u32 DEVICE array — per requirement-class, the instance
-        types whose requirements intersect the class's (pairwise screen;
-        the kernel's while_loop stays exact for three-way intersections,
-        offerings, and minValues). Stays on device end-to-end: the profile
-        showed pulling it to host only to re-upload in _upload_pod_tables
-        cost ~0.5s/solve in tunnel round-trips."""
+        """[NR_pad, IW] u32 DEVICE array — per requirement-class, the
+        instance types whose requirements intersect the class's (pairwise
+        screen; the kernel's while_loop stays exact for three-way
+        intersections, offerings, and minValues). Stays on device
+        end-to-end: the profile showed pulling it to host only to
+        re-upload in _upload_pod_tables cost ~0.5s/solve in tunnel
+        round-trips. Rows are bucket-padded in step with _cr_padded."""
         import jax.numpy as jnp
 
         I = p.num_types
         IW = max(1, (I + 31) // 32)
-        cr = np.asarray(p.rclass_creps, dtype=np.int64)
+        cr = self._cr_padded(p)
         NR = len(cr)
         chunks = []
         CH = 2048
@@ -745,6 +764,14 @@ class TpuScheduler:
         h_inverse = np.array([g.inverse for g in p.hgroups], dtype=bool).reshape(Gh)
         jreq = lambda r: Reqs(*(jnp.asarray(a) for a in r))
 
+        def pad_rt(a):
+            """Bucket the relaxable-rclass axis of the tier tables (rows
+            past the real count are never gathered — x.rrow holds real
+            ids only)."""
+            if not buckets.enabled():
+                return a
+            return buckets.pad_rows(a, buckets.bucket(a.shape[0], floor=1))
+
         def pad_reqs_rows(r: Reqs) -> Reqs:
             if r.mask.shape[0] > 0:
                 return jreq(r)
@@ -773,6 +800,11 @@ class TpuScheduler:
                 if p.orid is not None
                 else np.full(p.otype.shape[0], -1, np.int32)
             ),
+            ovalid=jnp.asarray(
+                p.ovalid
+                if p.ovalid is not None
+                else np.ones(p.otype.shape[0], bool)
+            ),
             v_kid=pad_group_v(p.v_kid),
             v_word=pad_group_v(p.v_word, fill=-1),
             v_bit=pad_group_v(p.v_bit),
@@ -790,19 +822,34 @@ class TpuScheduler:
                 if p.thp is not None
                 else np.zeros((p.num_templates, 0), np.uint32)
             ),
-            rt_preq=jreq(p.rt_preq),
+            rt_preq=Reqs(*(jnp.asarray(pad_rt(a)) for a in p.rt_preq)),
             rt_typeok=jnp.zeros(
                 (1, 1, max(1, (p.num_types + 31) // 32)), jnp.uint32
             ),
-            rt_tol_t=jnp.asarray(p.rt_tol_t),
-            rt_tol_e=jnp.asarray(p.rt_tol_e),
-            rt_kind=jnp.asarray(p.rt_kind),
-            rt_gid=jnp.asarray(p.rt_gid),
-            rt_sel=jnp.asarray(p.rt_sel),
+            rt_tol_t=jnp.asarray(pad_rt(p.rt_tol_t)),
+            rt_tol_e=jnp.asarray(pad_rt(p.rt_tol_e)),
+            rt_kind=jnp.asarray(pad_rt(p.rt_kind)),
+            rt_gid=jnp.asarray(pad_rt(p.rt_gid)),
+            rt_sel=jnp.asarray(pad_rt(p.rt_sel)),
         )
         # tier type-screens need tb.ireq/va: fill after base construction
         self._typeok = self._pod_typeok(p, tb)
-        return tb._replace(rt_typeok=self._tier_typeok(p, tb))
+        rt_typeok = self._tier_typeok(p, tb)
+        if buckets.enabled():
+            import jax
+
+            NRx_pad = buckets.bucket(int(rt_typeok.shape[0]), floor=1)
+            if NRx_pad > rt_typeok.shape[0]:
+                rt_typeok = jax.numpy.concatenate(
+                    [
+                        rt_typeok,
+                        jax.numpy.zeros(
+                            (NRx_pad - rt_typeok.shape[0],) + rt_typeok.shape[1:],
+                            rt_typeok.dtype,
+                        ),
+                    ]
+                )
+        return tb._replace(rt_typeok=rt_typeok)
 
     def _init_state(self, p: EncodedProblem, N: int):
         import jax.numpy as jnp
@@ -900,7 +947,7 @@ class TpuScheduler:
         requirement shapes ships KBs, not MBs."""
         import jax.numpy as jnp
 
-        cr = np.asarray(p.rclass_creps, dtype=np.int64)  # class idx per rclass
+        cr = self._cr_padded(p)  # class idx per rclass, bucket-padded
         Gv = max(len(p.vgroups), 1)
         Gh = max(len(p.hgroups), 1)
 
@@ -912,6 +959,22 @@ class TpuScheduler:
         def narrow(a):
             return a.astype(np.uint16) if a.max(initial=0) < 65536 else a
 
+        # bucket the class/selection axes so steady-state traffic with a
+        # drifting class mix reuses one compiled _gather_xs/_run_arrays
+        # program per rung (solver/buckets.py; pad rows are never gathered
+        # — the index columns only hold real ids)
+        if buckets.enabled():
+            NC_pad = buckets.bucket(p.prequests_c.shape[0])
+            U_pad = buckets.bucket(p.sel_rows_v.shape[0])
+            P_pad = buckets.bucket(len(p.pod_class))
+        else:
+            NC_pad = p.prequests_c.shape[0]
+            U_pad = p.sel_rows_v.shape[0]
+            P_pad = len(p.pod_class)
+        NR_pad = max(len(cr), 1)
+        pad_c = lambda a: buckets.pad_rows(a, NC_pad)
+        pad_u = lambda a: buckets.pad_rows(a, U_pad)
+        pad_p = lambda a: buckets.pad_rows(a, P_pad)
         self._dev_tables = (
             Reqs(*(jnp.asarray(a[cr]) for a in p.preq_c)),
             # _pod_typeok is already per requirement-class on device
@@ -921,16 +984,16 @@ class TpuScheduler:
             jnp.asarray(p.ptopo_kind_c[cr]),
             jnp.asarray(p.ptopo_gid_c[cr]),
             jnp.asarray(p.ptopo_sel_c[cr]),
-            jnp.asarray(p.rcls_of),
-            jnp.asarray(p.prequests_c),
-            jnp.asarray(narrow(p.pod_class)),
-            jnp.asarray(narrow(p.srow)),
-            jnp.asarray(pad_g(p.sel_rows_v, Gv)),
-            jnp.asarray(pad_g(p.sel_rows_h, Gh)),
-            jnp.asarray(pad_g(p.pinv_h_c, Gh)),
-            jnp.asarray(pad_g(p.pown_h_c, Gh)),
-            jnp.asarray(p.ntiers_r),
-            jnp.asarray(p.rrow_of_rcls),
+            jnp.asarray(pad_c(p.rcls_of)),
+            jnp.asarray(pad_c(p.prequests_c)),
+            jnp.asarray(pad_p(narrow(p.pod_class))),
+            jnp.asarray(pad_p(narrow(p.srow))),
+            jnp.asarray(pad_u(pad_g(p.sel_rows_v, Gv))),
+            jnp.asarray(pad_u(pad_g(p.sel_rows_h, Gh))),
+            jnp.asarray(pad_c(pad_g(p.pinv_h_c, Gh))),
+            jnp.asarray(pad_c(pad_g(p.pown_h_c, Gh))),
+            jnp.asarray(buckets.pad_rows(p.ntiers_r, NR_pad, fill=1)),
+            jnp.asarray(buckets.pad_rows(p.rrow_of_rcls, NR_pad)),
             jnp.asarray(p.php_own_c[cr]),
             jnp.asarray(p.php_conf_c[cr]),
         )

@@ -76,10 +76,12 @@ class Tables(NamedTuple):
     ireq: Reqs
     ialloc: jax.Array  # [I, R]
     icap: jax.Array  # [I, R]
-    # offerings [O]
+    # offerings [O]; rows with ovalid=False are bucket padding
+    # (solver/buckets.py) and must never witness "an offering exists"
     otype: jax.Array  # [O]
     oword: jax.Array  # [O, 3]
     obit: jax.Array  # [O, 3]
+    ovalid: jax.Array  # [O] bool
     # reservation index per offering (-1 = not a reserved offering);
     # zero-length when the problem has no reservations — every reservation
     # op below is Python-gated on NRES so reservation-free programs are
@@ -384,7 +386,7 @@ def _type_filter(
     fits = jnp.all(total <= tb.ialloc, axis=-1)
     ow = tb.oword
     off_bit = _gather_bits(final.mask, ow, tb.obit)  # [O, 3]
-    off_ok = jnp.all(off_bit | (ow < 0), axis=-1)
+    off_ok = jnp.all(off_bit | (ow < 0), axis=-1) & tb.ovalid
     off_any = jnp.zeros(tb.ireq.mask.shape[0], bool).at[tb.otype].max(off_ok)
     return alive_bits & t_ok & fits & off_any
 
@@ -732,7 +734,7 @@ def _step(tb: Tables, st: State, x: PodX):
         alive_r = jnp.where(pc, alive_cn, alive_tn)  # [I] bool
         alive_o = alive_r[jnp.clip(tb.otype, 0, None)]
         offb = _gather_bits(final_r.mask, tb.oword, tb.obit)  # [O, 3]
-        off_ok = jnp.all(offb | (tb.oword < 0), axis=-1)
+        off_ok = jnp.all(offb | (tb.oword < 0), axis=-1) & tb.ovalid
         cand_o = alive_o & off_ok & (tb.orid >= 0)
         cand_r = (
             jnp.zeros(NRES, bool).at[jnp.clip(tb.orid, 0, None)].max(cand_o)

@@ -31,6 +31,7 @@ from karpenter_tpu.ops.encode import Reqs, empty_reqs, encode_requirements
 from karpenter_tpu.ops.vocab import ResourceTable, UnsupportedProblem, Vocab, WORD_BITS
 from karpenter_tpu.scheduling import Requirements, Taints
 from karpenter_tpu.scheduling.hostports import get_host_ports
+from karpenter_tpu.solver import buckets
 from karpenter_tpu.solver.oracle import Scheduler
 from karpenter_tpu.solver.topology import TopologyGroup, TopologyType
 from karpenter_tpu.utils import resources as res
@@ -107,10 +108,13 @@ class EncodedProblem:
     ialloc: Optional[np.ndarray] = None  # [I, R] i32
     icap: Optional[np.ndarray] = None  # [I, R] i32
 
-    # offerings (flattened) [O]
+    # offerings (flattened) [O]; rows past num_offerings_real are bucket
+    # padding with ovalid=False (solver/buckets.py pad_offerings)
     otype: Optional[np.ndarray] = None  # [O] i32 owning type
     oword: Optional[np.ndarray] = None  # [O, 3] i32 word of zone/ct/rid bit (-1 = n/a)
     obit: Optional[np.ndarray] = None  # [O, 3] i32
+    ovalid: Optional[np.ndarray] = None  # [O] bool — real offering rows
+    num_offerings_real: int = 0
     # reserved-capacity bookkeeping (reservationmanager.go:28; round 5)
     orid: Optional[np.ndarray] = None  # [O] i32 reservation index (-1 none)
     num_reservations: int = 0
@@ -192,10 +196,9 @@ class EncodedProblem:
 
 
 def _pow2(n: int, floor: int = 8) -> int:
-    out = floor
-    while out < n:
-        out *= 2
-    return out
+    """Back-compat alias for the bucket ladder (solver/buckets.py owns
+    the pow-2 rung definition; importers of _pow2 predate it)."""
+    return buckets.bucket(n, floor)
 
 
 def _gate(cond: bool, why: str) -> None:
@@ -430,7 +433,14 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
         for freq in tg.node_filter.requirements:
             vocab.observe_requirements(freq)
     try:
-        vocab.finalize()
+        # bucket the vocab layout (words per key, key count) so label/key
+        # churn between solves reuses compiled shapes (solver/buckets.py)
+        if buckets.enabled():
+            vocab.finalize(
+                pad_words=buckets.bucket_words, pad_keys=buckets.bucket_keys
+            )
+        else:
+            vocab.finalize()
         table.finalize()
     except UnsupportedProblem as e:
         raise UnsupportedBySolver(str(e)) from e
@@ -710,6 +720,9 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
 
     # ---- pods ----------------------------------------------------------
     _encode_pod_classes(p, pods, group_vid, class_reqs)
+    # bucket the remaining compiled axes (instance types, offerings) —
+    # sentinel invisibility arguments live in solver/buckets.py
+    buckets.pad_problem(p)
     return p
 
 

@@ -1,0 +1,170 @@
+"""Shape-bucket parity matrix (solver/buckets.py; ISSUE 8).
+
+Two contracts:
+
+1. Decision invisibility: for problems straddling every bucketed axis's
+   pow-2 edge (pods N, instance types I, existing nodes E — just below /
+   at / above the edge), the bucketed TPU solve is bit-identical to the
+   oracle. The pads are sentinel rows the kernel provably cannot select;
+   this matrix is the empirical proof the module docstring's arguments
+   point at.
+
+2. Shape stability: two DIFFERENT real sizes in the same bucket hit the
+   identical compiled program — zero jaxpr traces and zero compiles on
+   the second solve, counted with the same jax.monitoring counter the
+   graftlint IR tier budgets (analysis/ir.py trace_events), so this gate
+   and `graftlint --ir` cannot drift on what "a retrace" means.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import labels as well_known
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.solver import buckets
+from karpenter_tpu.solver.nodes import StateNodeView
+from karpenter_tpu.solver.oracle import Scheduler
+from karpenter_tpu.solver.topology import Topology
+from karpenter_tpu.solver.tpu import TpuScheduler
+from karpenter_tpu.testing import fixtures
+
+
+def _views(n: int, its) -> list[StateNodeView]:
+    it = its[0]
+    return [
+        StateNodeView(
+            name=f"bucket-node-{i}",
+            node_labels={well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a"},
+            labels={
+                well_known.TOPOLOGY_ZONE_LABEL_KEY: "test-zone-a",
+                well_known.INSTANCE_TYPE_LABEL_KEY: it.name,
+                well_known.NODEPOOL_LABEL_KEY: "default",
+            },
+            available=dict(it.allocatable()),
+            capacity=dict(it.capacity),
+            initialized=True,
+        )
+        for i in range(n)
+    ]
+
+
+def _solve_pair(n_pods: int, n_types: int, n_existing: int):
+    """(tpu partition, oracle partition) for one problem size. Fresh
+    object graphs per side — shared mutable state would void the
+    comparison."""
+
+    def build():
+        fixtures.reset_rng(11)
+        its = construct_instance_types(sizes=[2, 8])[:n_types]
+        pool = fixtures.node_pool(name="default")
+        pods = fixtures.make_diverse_pods(n_pods)
+        views = _views(n_existing, its) if n_existing else None
+        topo = Topology(
+            [pool], {"default": its}, pods, state_node_views=views
+        )
+        return [pool], {"default": its}, pods, views, topo
+
+    def parts(r, pods):
+        names = {p.uid: p.name for p in pods}
+        claims = sorted(
+            tuple(sorted(names[p.uid] for p in c.pods))
+            for c in r.new_node_claims
+        )
+        existing = sorted(
+            (n.name, tuple(sorted(names[p.uid] for p in n.pods)))
+            for n in r.existing_nodes
+        )
+        return claims, existing, sorted(r.pod_errors)
+
+    pools, ibp, pods, views, topo = build()
+    r_t = TpuScheduler(pools, ibp, topo, views).solve(pods)
+    out_t = parts(r_t, pods)
+    pools, ibp, pods, views, topo = build()
+    r_o = Scheduler(pools, ibp, topo, views).solve(pods)
+    return out_t, parts(r_o, pods)
+
+
+def _edge_cases(edge: int) -> tuple[int, int, int]:
+    return (edge - 1, edge, edge + 1)
+
+
+@pytest.mark.parametrize("n_pods", _edge_cases(16))
+def test_pod_bucket_edges_oracle_parity(n_pods):
+    """Pods just below/at/above a pow-2 edge decide identically."""
+    got, want = _solve_pair(n_pods, n_types=12, n_existing=3)
+    assert got == want
+
+
+@pytest.mark.parametrize("n_existing", _edge_cases(8))
+def test_existing_bucket_edges_oracle_parity(n_existing):
+    """Existing-node slots straddling the E rung decide identically
+    (padded slots carry eavail=-1 and all-False tolerations)."""
+    got, want = _solve_pair(24, n_types=12, n_existing=n_existing)
+    assert got == want
+
+
+@pytest.mark.parametrize("n_types", _edge_cases(8))
+def test_type_bucket_edges_oracle_parity(n_types):
+    """Instance types straddling the I rung decide identically (padded
+    types are members of no template; padded offerings are ovalid=False)."""
+    got, want = _solve_pair(24, n_types=n_types, n_existing=0)
+    assert got == want
+
+
+def test_bucketing_is_on_by_default():
+    assert buckets.enabled()
+
+
+def test_padded_problem_shapes_are_rungs():
+    """The encoded problem's bucketed axes land on pow-2 rungs and the
+    sentinel rows carry their documented inert values."""
+    from karpenter_tpu.solver.tpu_problem import encode_problem
+
+    fixtures.reset_rng(11)
+    its = construct_instance_types(sizes=[2])[:9]  # 9 types -> rung 16
+    pool = fixtures.node_pool(name="default")
+    pods = fixtures.make_diverse_pods(10)
+    views = _views(3, its)  # 3 existing -> rung 8
+    topo = Topology([pool], {"default": its}, pods, state_node_views=views)
+    sched = TpuScheduler([pool], {"default": its}, topo, views)
+    p = encode_problem(sched.oracle, pods)
+    assert p.num_types == 16
+    assert p.num_existing == 8
+    assert p.otype.shape[0] == buckets.bucket(p.num_offerings_real)
+    # padded types belong to no template; padded offerings are invalid
+    assert not np.unpackbits(
+        p.ttypes.astype("<u4").view(np.uint8), axis=-1, bitorder="little"
+    )[:, 9:].any()
+    assert not p.ovalid[p.num_offerings_real :].any()
+    assert p.ovalid[: p.num_offerings_real].all()
+    # padded existing slots can fit nothing
+    assert (p.eavail[3:] == -1).all()
+    # vocab rungs: key count is a rung, phantom keys hold no values
+    assert p.vocab.num_keys == buckets.bucket_keys(p.vocab.num_keys)
+    for kid in range(p.vocab.num_keys):
+        if p.vocab.keys[kid].startswith(buckets.PAD_KEY_PREFIX):
+            assert p.vocab.values[kid] == []
+
+
+def test_same_bucket_sizes_share_the_compiled_program():
+    """Two different real sizes in one bucket: the second solve traces
+    and compiles NOTHING (the jax.monitoring counter test_compilecache
+    and the ir-retrace budget also ride)."""
+    from karpenter_tpu.analysis.ir import trace_events
+
+    def solve(n_pods):
+        fixtures.reset_rng(11)
+        its = construct_instance_types(sizes=[2])
+        pool = fixtures.node_pool(name="default")
+        pods = fixtures.make_generic_pods(n_pods)
+        topo = Topology([pool], {"default": its}, pods)
+        return TpuScheduler([pool], {"default": its}, topo).solve(pods)
+
+    solve(12)  # warm the 16-bucket programs
+    with trace_events() as ev:
+        r = solve(14)  # same rung, different real size
+    assert sum(len(c.pods) for c in r.new_node_claims) == 14
+    assert ev.traces == 0, f"same-bucket solve traced {ev.traces} programs"
+    assert ev.compiles == 0

@@ -14,12 +14,18 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _SOLVE_SCRIPT = r"""
 import json, os, sys, time
 
 t0 = time.monotonic()
+# real-backend-compile accounting lives in ONE place — analysis/ir.py
+# trace_events (backend_compile_duration events fire on persistent-cache
+# hits too; real builds = events minus cache_hits)
+from karpenter_tpu.analysis.ir import trace_events
 from karpenter_tpu.cloudprovider.kwok import construct_instance_types
 from karpenter_tpu.solver.topology import Topology
 from karpenter_tpu.solver.tpu import TpuScheduler
@@ -32,13 +38,17 @@ pods = fixtures.make_diverse_pods(48)
 topo = Topology([pool], {"default": its}, pods)
 sched = TpuScheduler([pool], {"default": its}, topo)
 t1 = time.monotonic()
-results = sched.solve(pods)
+with trace_events() as ev:
+    results = sched.solve(pods)
 t2 = time.monotonic()
 n_sched = sum(len(c.pods) for c in results.new_node_claims)
 print(json.dumps({
     "solve_seconds": t2 - t1,
+    "first_solve_from_start_seconds": t2 - t0,
     "scheduled": n_sched,
     "errors": len(results.pod_errors),
+    "backend_compiles": ev.backend_compiles,
+    "cache_hits": ev.cache_hits,
 }))
 """
 
@@ -62,6 +72,18 @@ def _run_solve(cache_dir: str) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+@pytest.fixture(scope="module")
+def warm_cache(tmp_path_factory):
+    """(cache_dir, cold result, warm result): one cold + one warm
+    subprocess run per module; every warm-path assertion rides the same
+    pair (subprocess solves are the expensive unit of this module)."""
+    cache_dir = str(tmp_path_factory.mktemp("xla-cache"))
+    r1 = _run_solve(cache_dir)
+    files1 = _cache_files(cache_dir)
+    r2 = _run_solve(cache_dir)
+    return cache_dir, r1, files1, r2
+
+
 def _cache_files(cache_dir: str) -> set[str]:
     found = set()
     for root, _, files in os.walk(cache_dir):
@@ -70,17 +92,16 @@ def _cache_files(cache_dir: str) -> set[str]:
     return found
 
 
-def test_cold_process_solve_rides_warm_cache(tmp_path):
-    cache_dir = str(tmp_path / "xla-cache")
-    r1 = _run_solve(cache_dir)
-    files1 = _cache_files(cache_dir)
+@pytest.mark.coldstart
+def test_cold_process_solve_rides_warm_cache(warm_cache):
+    cache_dir, r1, files1, r2 = warm_cache
     assert files1, "first process should populate the persistent cache"
     assert r1["scheduled"] > 0
 
-    r2 = _run_solve(cache_dir)
     files2 = _cache_files(cache_dir)
     # every program the solve needs must come FROM the cache: a second
-    # process adds no new entries
+    # process adds no new entries (the manifest the AOT prewarm writes is
+    # not a cache entry; it lives beside them)
     assert files2 == files1, (
         f"second process recompiled {len(files2 - files1)} programs"
     )
@@ -88,8 +109,25 @@ def test_cold_process_solve_rides_warm_cache(tmp_path):
     # the operational contract: a cold process with a warm cache completes
     # its Solve inside the reference's 1-minute budget (provisioner.go:366)
     assert r2["solve_seconds"] < 60.0, r2
-    # and far faster than a cold compile — the cache must actually be used
-    assert r2["solve_seconds"] < max(10.0, 0.5 * r1["solve_seconds"]), (r1, r2)
+
+
+@pytest.mark.coldstart
+def test_fresh_process_warm_cache_zero_backend_compiles(warm_cache):
+    """The ISSUE 8 acceptance pin: a fresh process with a warm disk cache
+    reaches its first steady-shape solve with ZERO XLA compiles — every
+    compile_or_get_cached call is served by deserializing a persisted
+    executable (cache_hits == calls). This is the exact property the
+    former `0.5 * cold_seconds` timing heuristic was a proxy for — the
+    proxy went flaky once tracing (per-process, cache-proof) became the
+    dominant warm-path term. The in-process same-bucket half of the
+    contract is the `same_bucket_solve_*` ir-retrace budget
+    (kernel_budgets.json)."""
+    _, r1, _, r2 = warm_cache
+    assert r1["backend_compiles"] > 0, (
+        "first (cold) process should have actually built programs"
+    )
+    assert r2["backend_compiles"] == 0, (r1, r2)
+    assert r2["cache_hits"] > 0, r2
 
 
 def test_second_solve_same_shape_zero_retraces_in_process():

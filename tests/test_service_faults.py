@@ -790,3 +790,138 @@ def test_trickling_frame_cannot_wedge_a_handler(server, monkeypatch):
     c = SolverClient(server.socket_path)
     assert c.ping(timeout=10.0)
     c.close()
+
+
+# ---------------------------------------------------------------------------
+# prewarm / readiness (ISSUE 8: the AOT ladder — docs/compile.md)
+
+
+def test_client_mid_prewarm_degrades_to_oracle_then_recovers():
+    """A client connecting MID-PREWARM must be served immediately — the
+    solve degrades to the (decision-identical) oracle fallback, never an
+    uncompiled device path — and PONG payloads expose readiness so
+    orchestration probes can gate traffic. After prewarm completes the
+    same problem solves on the normal path with the identical partition."""
+    release = threading.Event()
+
+    def stub_prewarm(stop):
+        # a deterministic stand-in for aot.prewarm: "compiling" until
+        # released, polling the server's stop flag like the real one
+        while not release.is_set() and not stop.is_set():
+            time.sleep(0.02)
+
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SolverServer(path, prewarm=True, prewarm_fn=stub_prewarm)
+    srv.start()
+    try:
+        client = SolverClient(path)
+        # readiness surfaces on the wire while the ladder compiles
+        kind, payload = client._roundtrip(KIND_PING, b"", 10.0)
+        assert kind == KIND_PONG and payload == b"prewarming"
+        assert not srv.ready.is_set()
+
+        pools, ibp, pods = _problem(8)
+        got = client.solve(
+            pools, ibp, pods,
+            options=SchedulerOptions(tpu_min_pods=0),
+            timeout=120.0,
+        )
+        # served DURING prewarm: degraded to the oracle, never the device
+        assert got["used_tpu"] is False
+        assert srv.oracle_degraded_solves == 1
+        degraded_parts = _remote_parts(got, pods)
+
+        release.set()
+        assert srv.ready.wait(timeout=10.0)
+        kind, payload = client._roundtrip(KIND_PING, b"", 10.0)
+        assert payload == b"ready"
+
+        pools, ibp, pods = _problem(8)
+        got2 = client.solve(
+            pools, ibp, pods,
+            options=SchedulerOptions(tpu_min_pods=0),
+            timeout=120.0,
+        )
+        # decision-identical across the degrade boundary
+        assert _remote_parts(got2, pods) == degraded_parts
+        assert srv.oracle_degraded_solves == 1  # no further degrades
+        client.close()
+    finally:
+        release.set()
+        srv.stop()
+
+
+def test_server_stop_interrupts_prewarm():
+    """stop() during prewarm must not hang on the ladder: the prewarm
+    loop polls the server's stop flag between combos."""
+    started = threading.Event()
+    aborted = threading.Event()
+
+    def stub_prewarm(stop):
+        started.set()
+        while not stop.is_set():
+            time.sleep(0.02)
+        aborted.set()
+
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SolverServer(path, prewarm=True, prewarm_fn=stub_prewarm)
+    srv.start()
+    assert started.wait(timeout=5.0)
+    t0 = time.monotonic()
+    srv.stop()
+    assert time.monotonic() - t0 < 10.0
+    assert aborted.wait(timeout=5.0)
+
+
+@pytest.mark.slow
+@pytest.mark.hard_timeout(600)
+def test_kill_mid_prewarm_does_not_poison_cache(tmp_path):
+    """SIGKILL during the AOT prewarm must leave the on-disk cache usable:
+    JAX writes cache entries atomically and the ladder manifest is
+    temp-file + rename (solver/aot.py), so the next process either reads
+    valid artifacts or recompiles — it never crashes on torn state."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    cache_dir = str(tmp_path / "xla-cache")
+    script = (
+        "import os\n"
+        f"os.environ['KARPENTER_COMPILATION_CACHE_DIR'] = {cache_dir!r}\n"
+        "from karpenter_tpu.solver import aot\n"
+        "out = aot.prewarm(max_pods=64, include_sweeps=False)\n"
+        # combos recorded before the kill are legitimately SKIPPED by the
+        # second run (their executables are already persisted); the
+        # ladder is complete when compiled + skipped covers it
+        "print('PREWARM_DONE', out['compiled'] + out['skipped'])\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+
+    # kill mid-flight: the first solve_runs compile takes ~15s cold, so
+    # 8s lands inside it (and after the cache dir exists)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script], env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    time.sleep(8.0)
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+
+    # torn state must read as "nothing recorded", never crash
+    from karpenter_tpu.solver import aot
+
+    manifest = aot.load_manifest(cache_dir)
+    assert isinstance(manifest.get("combos"), dict)
+
+    # a fresh process completes the SAME ladder against the survivor
+    # cache (partial entries are either valid — reused — or recompiled)
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=500,
+    )
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "PREWARM_DONE 4" in out.stdout, out.stdout
