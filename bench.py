@@ -47,9 +47,10 @@ import json, sys, time
 t0 = time.monotonic()  # process-start proxy: first line of the script
 n_pods, n_types = int(sys.argv[1]), int(sys.argv[2])
 sys.path.insert(0, ".")
-# real-backend-compile accounting lives in ONE place — analysis/ir.py
-# trace_events (compile events fire on persistent-cache hits too)
-from karpenter_tpu.analysis.ir import trace_events
+# real-backend-compile accounting lives in ONE place — karpenter_tpu.tracing
+# trace_events (compile events fire on persistent-cache hits too; the IR
+# tier re-exports the same object)
+from karpenter_tpu.tracing import trace_events
 from bench import build_universe, make_problem
 from karpenter_tpu.solver.tpu import TpuScheduler
 
@@ -141,8 +142,10 @@ def make_problem(n_pods: int, its, pods_fn=None, pools_fn=None):
 
 
 def time_tpu(n_pods, its, pods_fn=None, pools_fn=None):
-    """(steady pods/sec, compile seconds) — compile measured as first-call
-    minus steady-state."""
+    """(steady pods/sec, compile seconds, steady phase totals) — compile
+    measured as first-call minus steady-state; phases are the steady
+    run's top-level solve-trace totals (encode/order/upload/dispatch/
+    regrow/decode), so bench rows can show WHERE a regression landed."""
     from karpenter_tpu.solver.tpu import TpuScheduler
 
     pools, ibp, pods, topo = make_problem(n_pods, its, pods_fn, pools_fn)
@@ -152,15 +155,17 @@ def time_tpu(n_pods, its, pods_fn=None, pools_fn=None):
     n_err = len(r.pod_errors)
 
     pools, ibp, pods, topo = make_problem(n_pods, its, pods_fn, pools_fn)
+    sched = TpuScheduler(pools, ibp, topo)
     t0 = time.monotonic()
-    r = TpuScheduler(pools, ibp, topo).solve(pods)
+    r = sched.solve(pods)
     steady = time.monotonic() - t0
+    phases = dict(sched.last_profile.top_phases())
     log(
         f"  tpu: {steady:.2f}s steady ({n_pods / steady:.0f} pods/s), "
         f"compile {max(0.0, first - steady):.1f}s, {n_err} errors, "
         f"{len([c for c in r.new_node_claims if c.pods])} claims"
     )
-    return n_pods / steady, max(0.0, first - steady)
+    return n_pods / steady, max(0.0, first - steady), phases
 
 
 def time_oracle_full(n_pods, its, pods_fn=None, pools_fn=None):
@@ -375,7 +380,7 @@ def main() -> None:
 
     if args.quick:
         its = build_universe(144)
-        tpu_ps, compile_s = time_tpu(200, its)
+        tpu_ps, compile_s, _ = time_tpu(200, its)
         oracle_ps = time_oracle_full(200, its)
         print(json.dumps({
             "metric": "Scheduler.Solve pods/sec at 200 pending x 144 types (quick)",
@@ -404,7 +409,7 @@ def main() -> None:
 
         log("== config 2: 10k x 500, nodeSelector + taints/tolerations ==")
         its = build_universe(500)
-        tpu_ps, comp = time_tpu(10_000, its, pods_selector_taints, pools_tainted)
+        tpu_ps, comp, _ = time_tpu(10_000, its, pods_selector_taints, pools_tainted)
         orc_fn = oracle_curve([1000, 2000, 4000], its, pods_selector_taints, pools_tainted)
         orc = orc_fn(10_000)
         detail["c2_10kx500_selector_taints"] = {
@@ -415,7 +420,7 @@ def main() -> None:
 
         log("== config 3: 5k topology-heavy (spread + anti, 3 zones) ==")
         its = build_universe(500)
-        tpu_ps, comp = time_tpu(5_000, its, pods_topology_heavy, pools_three_zones)
+        tpu_ps, comp, _ = time_tpu(5_000, its, pods_topology_heavy, pools_three_zones)
         orc_fn = oracle_curve([500, 1000, 2000], its, pods_topology_heavy, pools_three_zones)
         orc = orc_fn(5_000)
         detail["c3_5k_topology_heavy"] = {
@@ -460,7 +465,7 @@ def main() -> None:
 
         log("== config 5: 50k x 1k, mixed spot/on-demand ==")
         its = build_universe(1000)
-        tpu_ps, comp = time_tpu(50_000, its)
+        tpu_ps, comp, _ = time_tpu(50_000, its)
         orc_fn = oracle_curve([1000, 2000, 4000], its)
         orc = orc_fn(50_000)
         detail["c5_50kx1k_mixed"] = {
@@ -473,20 +478,27 @@ def main() -> None:
     log("== headline: diverse mix, full-size oracle baseline ==")
     its = build_universe(args.types)
     log(f"universe: {len(its)} instance types")
-    tpu_ps, compile_s = time_tpu(args.pods, its)
+    tpu_ps, compile_s, phases = time_tpu(args.pods, its)
     oracle_ps = time_oracle_full(args.pods, its)
+    # per-phase breakdown of the steady headline run (tracing top-level
+    # spans): future bench rows show WHERE a regression landed — encode,
+    # upload, device dispatch, or decode — not just that one did
+    phase_total = sum(phases.values()) or 1.0
     detail["headline_diverse"] = {
         "tpu_pods_per_sec": round(tpu_ps, 1),
         "oracle_pods_per_sec": round(oracle_ps, 1),
         "speedup": round(tpu_ps / oracle_ps, 2),
         "compile_seconds": round(compile_s, 1),
         "baseline_kind": "full oracle run",
+        "phase_seconds": {k: round(v, 3) for k, v in sorted(phases.items())},
+        "phase_shares": {
+            k: round(v / phase_total, 3) for k, v in sorted(phases.items())
+        },
     }
 
-    if args.all:
-        with open("BENCH_DETAIL.json", "w") as f:
-            json.dump(detail, f, indent=2)
-        log("wrote BENCH_DETAIL.json")
+    # merge-not-clobber: the default (headline-only) run updates its row
+    # next to the --all configs' instead of erasing them
+    merge_detail(detail)
 
     print(
         json.dumps(

@@ -382,11 +382,13 @@ def all_rules() -> list[Rule]:
         rules_data,
         rules_docs,
         rules_kernel,
+        rules_metrics,
         rules_threads,
     )
 
     rules: list[Rule] = []
-    for mod in (rules_kernel, rules_data, rules_threads, rules_docs):
+    for mod in (rules_kernel, rules_data, rules_threads, rules_docs,
+                rules_metrics):
         rules.extend(r() for r in mod.RULES)
     return rules
 

@@ -212,76 +212,18 @@ def kernel_metrics(jaxpr: Any) -> dict[str, int]:
 # ---------------------------------------------------------------------------
 # trace/compile event counter (jax.monitoring duration events fire once
 # per jaxpr trace / backend compile and NOT on cache hits — the counter
-# the retrace contract and tests/test_compilecache.py both ride)
+# the retrace contract and tests/test_compilecache.py both ride).
+# The implementation lives in karpenter_tpu.tracing (shared telemetry:
+# runtime solves export the same events as metrics); re-exported here so
+# the IR tier and its historical importers keep one spelling.
 
-_COUNTS = {"traces": 0, "compiles": 0, "cache_hits": 0}
-_LISTENER_INSTALLED = False
-
-
-def _install_listener() -> None:
-    global _LISTENER_INSTALLED
-    if _LISTENER_INSTALLED:
-        return
-    import jax
-
-    def _on_duration(name: str, secs: float, **kw: Any) -> None:
-        if name == "/jax/core/compile/jaxpr_trace_duration":
-            _COUNTS["traces"] += 1
-        elif name == "/jax/core/compile/backend_compile_duration":
-            _COUNTS["compiles"] += 1
-
-    def _on_event(name: str, **kw: Any) -> None:
-        if name == "/jax/compilation_cache/cache_hits":
-            _COUNTS["cache_hits"] += 1
-
-    jax.monitoring.register_event_duration_secs_listener(_on_duration)
-    jax.monitoring.register_event_listener(_on_event)
-    _LISTENER_INSTALLED = True
-
-
-class trace_events(contextlib.AbstractContextManager):
-    """Counts jaxpr traces and backend compiles inside the block.
-
-        with trace_events() as ev:
-            solve()
-        assert ev.traces == 0
-
-    Properties read live, so mid-block checkpoints work too. There is no
-    listener-unregister API in jax.monitoring — one module-level listener
-    feeds a global counter and contexts snapshot it.
-
-    `compiles` counts the backend_compile_duration event, which fires per
-    compile_or_get_cached call — INCLUDING persistent-cache hits (the
-    event wraps the whole fetch-or-build step). `backend_compiles`
-    subtracts the cache-hit events, so it is the number of programs XLA
-    actually built: the metric the zero-compile cold-start contract pins
-    (a fresh process with a warm disk cache must show 0)."""
-
-    def __enter__(self) -> "trace_events":
-        _install_listener()
-        self._t0 = _COUNTS["traces"]
-        self._c0 = _COUNTS["compiles"]
-        self._h0 = _COUNTS["cache_hits"]
-        return self
-
-    def __exit__(self, *exc: Any) -> None:
-        return None
-
-    @property
-    def traces(self) -> int:
-        return _COUNTS["traces"] - self._t0
-
-    @property
-    def compiles(self) -> int:
-        return _COUNTS["compiles"] - self._c0
-
-    @property
-    def cache_hits(self) -> int:
-        return _COUNTS["cache_hits"] - self._h0
-
-    @property
-    def backend_compiles(self) -> int:
-        return max(0, self.compiles - self.cache_hits)
+from karpenter_tpu.tracing import (  # noqa: E402  (re-export)
+    _COUNTS,
+    trace_events,
+)
+from karpenter_tpu.tracing import (  # noqa: E402  (re-export)
+    install_compile_listener as _install_listener,
+)
 
 
 @contextlib.contextmanager

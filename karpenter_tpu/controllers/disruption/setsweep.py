@@ -66,6 +66,7 @@ from typing import Optional
 
 import numpy as np
 
+from karpenter_tpu import tracing
 from karpenter_tpu.controllers.disruption.sweep import (
     SweepUnsupported,
     build_union,
@@ -174,13 +175,14 @@ class SetSweepContext:
 
     @classmethod
     def build(
-        cls, kube, cluster, cloud_provider, candidates, options=None
+        cls, kube, cluster, cloud_provider, candidates, options=None,
+        trace=None,
     ) -> "SetSweepContext":
         """Union gates + set-kernel gates + int64 guards + one table
         upload. Raises SweepUnsupported when the set kernel cannot
         express the shape (the controller falls down the ladder). The
         persistent compile cache is configured by the solver package
-        import."""
+        import. `trace` collects the union encode/upload spans."""
         import jax
         import jax.numpy as jnp
 
@@ -192,7 +194,8 @@ class SetSweepContext:
 
         if not candidates:
             raise SweepUnsupported("no candidates for set sweep")
-        u = build_union(kube, cluster, cloud_provider, candidates, options)
+        u = build_union(kube, cluster, cloud_provider, candidates, options,
+                        trace=trace)
         p = u.problem
         reason = fast_gate_reason(p)
         if reason is not None:
@@ -264,12 +267,15 @@ class SetSweepContext:
             trivial=False,
         )
 
-    def evaluate(self, member: np.ndarray) -> np.ndarray:
+    def evaluate(self, member: np.ndarray, trace=None) -> np.ndarray:
         """feasible[B] for a [B, J] boolean/0-1 membership batch — ONE
         bounded device dispatch (per-set host round-trips would defeat
         the design; the setsweep[runtime] ir-transfer budget pins the
         dispatch count). Lane counts pad to pow-2 buckets so every round
-        size shares a compiled program."""
+        size shares a compiled program. Each dispatch records a span on
+        `trace` plus the dispatch/lane counters (sets-per-dispatch =
+        karpenter_sweep_set_lanes_total / karpenter_solve_dispatches_total
+        {path=setsweep})."""
         import jax
         import jax.numpy as jnp
 
@@ -291,8 +297,15 @@ class SetSweepContext:
         Jp = int(self.percand_counts.shape[0])
         padded = np.zeros((Bp, Jp), np.int32)
         padded[:B, : self.n_candidates] = member.astype(np.int32)
-        out = self._dispatch(jnp.asarray(padded))
-        return np.asarray(jax.device_get(out))[:B].astype(bool)
+        with tracing.span_of(trace, "dispatch", path="setsweep", lanes=B):
+            out = self._dispatch(jnp.asarray(padded))
+            feas = np.asarray(jax.device_get(out))[:B].astype(bool)
+        if trace is not None:
+            trace.count("dispatches")
+            trace.count("set_lanes", by=B)
+        tracing.SOLVE_DISPATCHES.inc({"path": "setsweep"})
+        tracing.SWEEP_SET_LANES.inc(by=B)
+        return feas
 
     def _dispatch(self, member_dev):
         """The single jitted call per proposal round (counted by the
@@ -415,12 +428,32 @@ def sweep_sets(consolidation, candidates: list[Candidate]) -> Command:
     the problem."""
     from karpenter_tpu.controllers.disruption.types import command_savings
 
+    tr = tracing.new_trace("setsweep")
+    tr.annotate(candidates=len(candidates))
+    try:
+        cmd = _sweep_sets_traced(consolidation, candidates, command_savings, tr)
+    except SweepUnsupported:
+        # ladder control flow (the controller falls to the prefix rung);
+        # finish keeps unsupported traces out of the /debug/solves ring
+        tr.finish("unsupported")
+        raise
+    except BaseException:
+        tr.finish("error")
+        raise
+    tr.finish("ok")
+    return cmd
+
+
+def _sweep_sets_traced(
+    consolidation, candidates: list[Candidate], command_savings, tr
+) -> Command:
     ctx = SetSweepContext.build(
         consolidation.kube,
         consolidation.cluster,
         consolidation.cloud,
         candidates,
         consolidation.opts,
+        trace=tr,
     )
     clock = consolidation.clock
     deadline = (
@@ -431,11 +464,12 @@ def sweep_sets(consolidation, candidates: list[Candidate]) -> Command:
     feasible_masks: list[np.ndarray] = []
     best_mask: Optional[np.ndarray] = None
     best_est = -1.0
-    batch = proposer.first_round()
+    with tr.span("propose"):
+        batch = proposer.first_round()
     rounds = 0
     lanes = 0
     while len(batch) and rounds < MAX_SET_ROUNDS and clock.now() <= deadline:
-        feas = ctx.evaluate(batch)
+        feas = ctx.evaluate(batch, trace=tr)
         rounds += 1
         lanes += len(batch)
         ests = ctx.savings_estimate(batch)
@@ -449,7 +483,8 @@ def sweep_sets(consolidation, candidates: list[Candidate]) -> Command:
                 improved = True
         if not improved or best_mask is None:
             break
-        batch = proposer.neighborhood(best_mask)
+        with tr.span("propose"):
+            batch = proposer.neighborhood(best_mask)
 
     # ---- materialize -----------------------------------------------------
     # Kernel feasibility is SCHEDULABILITY; compute_consolidation also
@@ -468,7 +503,8 @@ def sweep_sets(consolidation, candidates: list[Candidate]) -> Command:
         reverse=True,
     )
     for k in feasible_ks:
-        cmd = consolidation.compute_consolidation(candidates[:k])
+        with tr.span("materialize", prefix=k):
+            cmd = consolidation.compute_consolidation(candidates[:k])
         if cmd.candidates:
             best_cmd, best_savings = cmd, command_savings(cmd)
             break
@@ -484,7 +520,8 @@ def sweep_sets(consolidation, candidates: list[Candidate]) -> Command:
         if clock.now() > deadline and best_cmd.candidates:
             break
         subset = [c for j, c in enumerate(candidates) if r[j]]
-        cmd = consolidation.compute_consolidation(subset)
+        with tr.span("materialize", set_size=len(subset)):
+            cmd = consolidation.compute_consolidation(subset)
         if not cmd.candidates:
             continue
         s = command_savings(cmd)
@@ -502,6 +539,7 @@ def sweep_sets(consolidation, candidates: list[Candidate]) -> Command:
         winner_nodes=len(best_cmd.candidates),
         winner_savings_per_hour=best_savings,
     )
+    tr.annotate(**last_search_stats)
     return best_cmd
 
 

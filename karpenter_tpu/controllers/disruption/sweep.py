@@ -59,6 +59,7 @@ from typing import Optional
 
 import numpy as np
 
+from karpenter_tpu import tracing
 from karpenter_tpu.api import labels as well_known
 from karpenter_tpu.controllers.disruption.types import Candidate
 from karpenter_tpu.controllers.state import cluster_source, is_reschedulable
@@ -233,7 +234,7 @@ def fast_gate_reason(problem) -> Optional[str]:
 
 def _fast_prefix_feasibility(
     sched, problem, candidates, view_slot, order, pod_prefix, tb, base_st,
-    singleton=False,
+    singleton=False, trace=None,
 ):
     """Gate-check + run the delta-state sweep kernel; None = gates failed,
     caller falls back to the vmapped full-state sweep. tb/base_st come
@@ -296,17 +297,20 @@ def _fast_prefix_feasibility(
         _fast_sweep_cached = jax.jit(
             _fast_sweep_kernel, static_argnames=("singleton",)
         )
-    feasible = _fast_sweep_cached(
-        tb,
-        base_st,
-        x_row,
-        jnp.asarray(p.eavail),
-        jnp.asarray(cand_idx),
-        jnp.asarray(counts),
-        jnp.asarray(sizes),
-        singleton=singleton,
-    )
-    return [bool(v) for v in np.asarray(jax.device_get(feasible))]
+    with tracing.span_of(
+        trace, "dispatch", path="sweep_fast", lanes=len(candidates)
+    ):
+        feasible = _fast_sweep_cached(
+            tb,
+            base_st,
+            x_row,
+            jnp.asarray(p.eavail),
+            jnp.asarray(cand_idx),
+            jnp.asarray(counts),
+            jnp.asarray(sizes),
+            singleton=singleton,
+        )
+        return [bool(v) for v in np.asarray(jax.device_get(feasible))]
 
 
 class UnionSweep:
@@ -334,7 +338,8 @@ class UnionSweep:
 
 
 def build_union(
-    kube, cluster, cloud_provider, candidates: list[Candidate], options=None
+    kube, cluster, cloud_provider, candidates: list[Candidate], options=None,
+    trace=None,
 ) -> UnionSweep:
     """Shared front half of every batched sweep: the union gates
     (nodepool limits, draining non-candidates, missing views, host
@@ -342,7 +347,9 @@ def build_union(
     one-per-sweep device table upload. Raises SweepUnsupported on any
     gate; the caller picks the lane semantics (prefix / singleton /
     arbitrary membership sets). The persistent compile cache is
-    configured by the solver package import."""
+    configured by the solver package import. `trace` (tracing.Trace)
+    collects the encode/order/upload phase spans when the caller rides
+    a sweep trace."""
     node_pools = [np_ for np_ in kube.list("NodePool") if np_.replicas is None]
     if any(np_.limits for np_ in node_pools):
         raise SweepUnsupported("nodepool limits make per-prefix state diverge")
@@ -401,7 +408,8 @@ def build_union(
         ),
     )
     try:
-        problem = encode_problem(sched.oracle, pods)
+        with tracing.span_of(trace, "encode", pods=len(pods)):
+            problem = encode_problem(sched.oracle, pods)
     except UnsupportedBySolver as e:
         raise SweepUnsupported(str(e)) from e
     if problem.num_host_ports:
@@ -421,8 +429,9 @@ def build_union(
         key=lambda i: ffd_sort_key(pods[i], data[pods[i].uid].requests),
     )
 
-    tb = sched._tables(problem)  # also sets sched._typeok
-    sched._upload_pod_tables(problem)
+    with tracing.span_of(trace, "upload"):
+        tb = sched._tables(problem)  # also sets sched._typeok
+        sched._upload_pod_tables(problem)
     # a consolidation-feasible removal set opens at most 1 new claim; a
     # set that overflows even a handful of slots is infeasible anyway
     N = 8
@@ -439,6 +448,7 @@ def prefix_feasibility(
     candidates: list[Candidate],
     options=None,
     singleton: bool = False,
+    trace=None,
 ) -> list[bool]:
     """[len(candidates)] — feasible(k), all lanes evaluated in one device
     call. Prefix mode (multi-node consolidation): lane k removes
@@ -447,6 +457,31 @@ def prefix_feasibility(
     per-candidate instead of cumulative deltas (singlenodeconsolidation
     .go:56 loops these simulations sequentially; here they are
     independent device lanes)."""
+    tr = trace if trace is not None else tracing.new_trace("sweep")
+    tr.annotate(candidates=len(candidates), singleton=singleton)
+    try:
+        out = _prefix_feasibility_traced(
+            kube, cluster, cloud_provider, candidates, options, singleton, tr
+        )
+    except SweepUnsupported:
+        # expected ladder control flow, not a failure: the controller
+        # falls to the next strategy rung (finish keeps these out of
+        # the ring)
+        if trace is None:
+            tr.finish("unsupported")
+        raise
+    except BaseException:
+        if trace is None:
+            tr.finish("error")
+        raise
+    if trace is None:
+        tr.finish("ok")
+    return out
+
+
+def _prefix_feasibility_traced(
+    kube, cluster, cloud_provider, candidates, options, singleton, tr
+) -> list[bool]:
     import jax
     import jax.numpy as jnp
 
@@ -458,7 +493,8 @@ def prefix_feasibility(
     if B > MAX_SWEEP_PREFIXES:
         raise SweepUnsupported(f"{B} prefixes > {MAX_SWEEP_PREFIXES}")
 
-    u = build_union(kube, cluster, cloud_provider, candidates, options)
+    u = build_union(kube, cluster, cloud_provider, candidates, options,
+                    trace=tr)
     sched, problem, pods = u.sched, u.problem, u.pods
     pod_prefix, order, view_slot = u.pod_prefix, u.order, u.view_slot
     tb, base = u.tb, u.base
@@ -466,11 +502,15 @@ def prefix_feasibility(
     # delta-state fast path: under the bulk gates the whole sweep is C
     # cumsum steps on device (see _fast_sweep_kernel); the vmapped
     # full-state scan below remains the exact fallback for everything else
+    # (the dispatch span lives INSIDE _fast_prefix_feasibility, around the
+    # kernel call only — a declined gate check is not a device dispatch)
     fast = _fast_prefix_feasibility(
-        sched, problem, candidates, view_slot, order, pod_prefix, tb, base,
-        singleton=singleton,
+        sched, problem, candidates, view_slot, order, pod_prefix, tb,
+        base, singleton=singleton, trace=tr,
     )
     if fast is not None:
+        tr.count("dispatches")
+        tracing.SOLVE_DISPATCHES.inc({"path": "sweep"})
         return fast
     # fast gates failed: the vmapped full-state scan below is exact but
     # carries B x full State (measured 39s at 2k nodes round 3) — on big
@@ -640,7 +680,10 @@ def prefix_feasibility(
             in_axes=(None, st_axes, xs_axes),
         )
     )
-    st_out, kinds, slots, over = sweep(tb, st_b, xs_b)
+    with tr.span("dispatch", path="sweep_vmap", lanes=B):
+        st_out, kinds, slots, over = sweep(tb, st_b, xs_b)
+    tr.count("dispatches")
+    tracing.SOLVE_DISPATCHES.inc({"path": "sweep"})
     kinds = np.asarray(jax.device_get(kinds))  # [B, P_pad]
     n_claims = np.asarray(jax.device_get(st_out.n_claims))  # [B]
     over = np.asarray(jax.device_get(over))

@@ -10,23 +10,39 @@ thread when `Options.probe_port` is set (port 0 picks a free one):
 - /readyz   — readiness: the cluster-state cache is synced with the store
   (the same barrier every controller takes before acting, cluster.go:118).
 - /metrics  — the Prometheus-style exposition of karpenter_tpu.metrics.
+- /debug/solves       — recent solve-trace summaries from the bounded
+  telemetry ring (karpenter_tpu.tracing; docs/observability.md). Always
+  on: the ring + phase histograms are the default-cost telemetry tier.
+- /debug/solves/<id>  — the full phase waterfall of one trace; a wire
+  correlation id returns BOTH the client- and server-side halves.
 
 When constructed with enable_profiling=True (operator.go:183 --enable-
 profiling gate) it additionally serves the pprof analogs from
-karpenter_tpu.profiling:
+karpenter_tpu.profiling — and flips the tracing detail gate, so traces
+carry per-dispatch sub-spans (pod_xs/kernel/fetch) while the gate is up:
 
 - /debug/pprof/profile?seconds=N — sampling CPU profile of every live
   thread, collapsed-stack format (add &top=1 for a pprof-top table).
+  N is clamped to MAX_PROFILE_SECONDS; non-numeric or non-positive N
+  answers 400 (a handler thread must never block on attacker-shaped
+  query strings).
 - /debug/pprof/heap — tracemalloc top allocation sites.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from karpenter_tpu import metrics
+from karpenter_tpu import metrics, tracing
+
+# hard ceiling on one /debug/pprof/profile sampling window: the handler
+# thread blocks for the whole window, so the query string must not be able
+# to park it for arbitrary time (operator.go:183's pprof has the same
+# property via http server timeouts)
+MAX_PROFILE_SECONDS = 60.0
 
 
 class ProbeServer:
@@ -45,6 +61,7 @@ class ProbeServer:
         self._port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._detail_set = False  # we flipped the tracing detail gate
 
     @property
     def port(self) -> int:
@@ -53,6 +70,11 @@ class ProbeServer:
     def start(self) -> None:
         kube, cluster = self.kube, self.cluster
         profiling_on = self.enable_profiling
+        # the pprof gate doubles as the per-span-detail gate: while it is
+        # up, traces record each dispatch's pod_xs/kernel/fetch sub-spans
+        if profiling_on and not tracing.detail_enabled():
+            tracing.set_detail(True)
+            self._detail_set = True
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # quiet
@@ -82,6 +104,28 @@ class ProbeServer:
                         self._reply(503, f"metrics unavailable: {e}")
                         return
                     self._reply(200, body, ctype="text/plain; version=0.0.4")
+                elif self.path == "/debug/solves":
+                    # newest first; summaries only (spans via /<id>)
+                    body = json.dumps(
+                        [
+                            t.to_dict(summary=True)
+                            for t in reversed(tracing.RING.snapshot())
+                        ]
+                    )
+                    self._reply(200, body, ctype="application/json")
+                elif self.path.startswith("/debug/solves/"):
+                    ident = self.path[len("/debug/solves/"):]
+                    found = tracing.RING.find(ident)
+                    if not found:
+                        self._reply(404, f"no trace {ident!r} in the ring")
+                        return
+                    # a wire id matches the client- AND server-side halves
+                    # of one logical trace; the waterfall is the spans
+                    # ordered by t0 within each half
+                    body = json.dumps(
+                        {"id": ident, "traces": [t.to_dict() for t in found]}
+                    )
+                    self._reply(200, body, ctype="application/json")
                 elif self.path.startswith("/debug/pprof/") and profiling_on:
                     from urllib.parse import parse_qs, urlparse
 
@@ -98,7 +142,9 @@ class ProbeServer:
                         if not (seconds > 0):  # also rejects NaN
                             self._reply(400, "seconds must be positive")
                             return
-                        sampler = profiling.profile_cpu(min(seconds, 60.0))
+                        sampler = profiling.profile_cpu(
+                            min(seconds, MAX_PROFILE_SECONDS)
+                        )
                         body = (
                             sampler.render_top()
                             if q.get("top", ["0"])[0] == "1"
@@ -117,6 +163,9 @@ class ProbeServer:
         self._thread.start()
 
     def stop(self) -> None:
+        if self._detail_set:
+            tracing.set_detail(False)
+            self._detail_set = False
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

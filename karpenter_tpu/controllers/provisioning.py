@@ -19,7 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
-from karpenter_tpu import logging, metrics
+from karpenter_tpu import logging, metrics, tracing
 from karpenter_tpu.api import labels as well_known
 from karpenter_tpu.api.objects import (
     NodeClaim,
@@ -229,6 +229,7 @@ class Provisioner:
         self.solver = solver
         self.log = logging.root.named("provisioner")
         self.last_solver_used: Optional[str] = None
+        self.last_trace = None  # the most recent schedule()'s solve trace
 
     # -- triggers (provisioning/controller.go:44) ------------------------
 
@@ -341,69 +342,84 @@ class Provisioner:
 
     def schedule(self, pods: list[Pod]) -> Results:
         """provisioner.go:303 Schedule: build scheduler inputs from live
-        cluster state and run one Solve."""
-        node_pools = [
-            np
-            for np in self.kube.list("NodePool")
-            if np.replicas is None  # static pools provision via their own loop
-        ]
-        its_by_pool = {
-            np.name: self.cloud.get_instance_types(np) for np in node_pools
-        }
-        daemonset_pods = [
-            ds.pod_template for ds in self.kube.list("DaemonSet")
-        ]
-        pods = [p.deep_copy() for p in pods]
-        for p in pods:
-            self.volume_topology.inject(p)  # provisioner.go:286
-        views = self.cluster.schedulable_node_views()
+        cluster state and run one Solve. The whole Solve rides ONE trace
+        (karpenter_tpu.tracing) from here down — through ResilientSolver,
+        the wire client, and the kernel driver's host phases — landing in
+        the /debug/solves ring; `last_trace` exposes it to tests."""
+        with tracing.maybe_trace(None, "provisioning") as tr:
+            self.last_trace = tr
+            tr.annotate(pods=len(pods))
+            with tr.span("build_inputs"):
+                node_pools = [
+                    np
+                    for np in self.kube.list("NodePool")
+                    if np.replicas is None  # static pools have their own loop
+                ]
+                its_by_pool = {
+                    np.name: self.cloud.get_instance_types(np)
+                    for np in node_pools
+                }
+                daemonset_pods = [
+                    ds.pod_template for ds in self.kube.list("DaemonSet")
+                ]
+                pods = [p.deep_copy() for p in pods]
+                for p in pods:
+                    self.volume_topology.inject(p)  # provisioner.go:286
+                views = self.cluster.schedulable_node_views()
 
-        scheduler_options = SchedulerOptions(
-            ignore_preferences=self.opts.preference_policy == "Ignore",
-            min_values_best_effort=self.opts.min_values_policy == "BestEffort",
-            reserved_capacity_enabled=self.opts.feature_gates.reserved_capacity,
-            timeout_seconds=self.opts.solve_timeout_seconds,
-            claim_slot_div=self.opts.tpu_claim_slot_div,
-            tpu_min_pods=self.opts.tpu_min_pods,
-        )
-        source = cluster_source(self.kube, self.cluster)
+                scheduler_options = SchedulerOptions(
+                    ignore_preferences=self.opts.preference_policy == "Ignore",
+                    min_values_best_effort=self.opts.min_values_policy
+                    == "BestEffort",
+                    reserved_capacity_enabled=(
+                        self.opts.feature_gates.reserved_capacity
+                    ),
+                    timeout_seconds=self.opts.solve_timeout_seconds,
+                    claim_slot_div=self.opts.tpu_claim_slot_div,
+                    tpu_min_pods=self.opts.tpu_min_pods,
+                )
+                source = cluster_source(self.kube, self.cluster)
 
-        if self.solver is not None:
-            # The resilient sidecar boundary: remote solve under a circuit
-            # breaker, in-process ladder as the floor. Never raises for
-            # solver-side faults — every pending pod gets a decision (or a
-            # pod_error) in THIS reconcile (ISSUE acceptance).
-            results = self.solver.solve(
+            if self.solver is not None:
+                # The resilient sidecar boundary: remote solve under a
+                # circuit breaker, in-process ladder as the floor. Never
+                # raises for solver-side faults — every pending pod gets a
+                # decision (or a pod_error) in THIS reconcile.
+                results = self.solver.solve(
+                    node_pools,
+                    its_by_pool,
+                    pods,
+                    state_node_views=views,
+                    daemonset_pods=daemonset_pods,
+                    options=scheduler_options,
+                    cluster=source,
+                    force_oracle=self.force_oracle,
+                    trace=tr,
+                )
+                self.last_solver_used = self.solver.last_used
+                tr.annotate(solver=self.last_solver_used)
+                if self.solver.fallback_reason:
+                    self.log.info(
+                        "solver degraded",
+                        reason=self.solver.fallback_reason,
+                        solver=self.last_solver_used,
+                    )
+                return results
+
+            results, scheduler = solve_in_process(
                 node_pools,
                 its_by_pool,
                 pods,
-                state_node_views=views,
-                daemonset_pods=daemonset_pods,
-                options=scheduler_options,
+                views,
+                daemonset_pods,
+                scheduler_options,
                 cluster=source,
                 force_oracle=self.force_oracle,
+                trace=tr,
             )
-            self.last_solver_used = self.solver.last_used
-            if self.solver.fallback_reason:
-                self.log.info(
-                    "solver degraded",
-                    reason=self.solver.fallback_reason,
-                    solver=self.last_solver_used,
-                )
+            self.last_solver_used = "tpu" if scheduler.used_tpu else "oracle"
+            tr.annotate(solver=self.last_solver_used)
             return results
-
-        results, scheduler = solve_in_process(
-            node_pools,
-            its_by_pool,
-            pods,
-            views,
-            daemonset_pods,
-            scheduler_options,
-            cluster=source,
-            force_oracle=self.force_oracle,
-        )
-        self.last_solver_used = "tpu" if scheduler.used_tpu else "oracle"
-        return results
 
     def create_node_claims(self, results: Results) -> list[NodeClaim]:
         """provisioner.go:407 Create: persist NodeClaims for the solver's

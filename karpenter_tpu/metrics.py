@@ -158,6 +158,23 @@ class Store:
             self.gauge.delete(labels)
 
 
+def _escape_help(text: str) -> str:
+    """Prometheus text-format HELP escaping: backslash and newline."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, quote,
+    newline — an unescaped quote or newline in a label (a fallback reason,
+    an error string) would corrupt the whole exposition."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class Registry:
     def __init__(self):
         self.metrics: dict[str, Metric] = {}
@@ -189,7 +206,7 @@ class Registry:
         with self._lock:
             snapshot = list(self.metrics.values())
         for m in snapshot:
-            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             kind = (
                 "counter"
                 if isinstance(m, Counter)
@@ -203,14 +220,18 @@ class Registry:
                 if not m.label_names:
                     return ""
                 pairs = ",".join(
-                    f'{n}="{v}"' for n, v in zip(m.label_names, key)
+                    f'{n}="{_escape_label(v)}"'
+                    for n, v in zip(m.label_names, key)
                 )
                 return "{" + pairs + "}"
 
             if isinstance(m, Histogram):
                 counts_s, sums_s, totals_s = m.snapshot()
                 for k, counts in counts_s.items():
-                    base = [f'{n}="{v}"' for n, v in zip(m.label_names, k)]
+                    base = [
+                        f'{n}="{_escape_label(v)}"'
+                        for n, v in zip(m.label_names, k)
+                    ]
                     for b, c in zip(m.buckets, counts):
                         pairs = ",".join(base + [f'le="{b}"'])
                         lines.append(f"{m.name}_bucket{{{pairs}}} {c}")

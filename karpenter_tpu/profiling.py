@@ -145,29 +145,8 @@ def device_trace(logdir: str) -> Iterator[None]:
         yield
 
 
-class SolveProfile:
-    """Per-solve wall-clock phase breakdown (encode / device / decode) —
-    the Measure defer-timer analog (pkg/metrics/constants.go:63) scoped to
-    the solver. Used by profile_scan.py and ad-hoc investigation."""
-
-    def __init__(self):
-        self.phases: dict[str, float] = {}
-
-    @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[None]:
-        t0 = time.monotonic()
-        try:
-            yield
-        finally:
-            self.phases[name] = self.phases.get(name, 0.0) + (
-                time.monotonic() - t0
-            )
-
-    def render(self) -> str:
-        total = sum(self.phases.values()) or 1.0
-        return "\n".join(
-            f"{name:12s} {dt:8.3f}s {100.0 * dt / total:5.1f}%"
-            for name, dt in sorted(
-                self.phases.items(), key=lambda kv: -kv[1]
-            )
-        )
+# The per-solve phase breakdown (the Measure defer-timer analog,
+# pkg/metrics/constants.go:63) lives in karpenter_tpu.tracing since the
+# telemetry PR: TpuScheduler.last_profile is a tracing.Trace — .phases /
+# .top_phases() / .render() give the breakdown the old SolveProfile did,
+# plus spans, the /debug/solves ring, and the phase metrics.

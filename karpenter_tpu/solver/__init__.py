@@ -26,6 +26,14 @@ from karpenter_tpu.jaxsetup import ensure_compilation_cache
 
 ensure_compilation_cache()
 
+# jax.monitoring compile/retrace events -> karpenter_jax_compilation_
+# events_total: every runtime solve surfaces backend compiles / cache
+# hits as metrics, not only graftlint --ir runs (karpenter_tpu.tracing
+# owns the shared listener; importing this package implies jax loads)
+from karpenter_tpu.tracing import install_compile_listener
+
+install_compile_listener()
+
 from karpenter_tpu.solver.hybrid import (
     CircuitBreaker,
     HybridScheduler,

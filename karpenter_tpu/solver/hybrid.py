@@ -133,7 +133,7 @@ class HybridScheduler:
             self.oracle = self.tpu.oracle
         self.opts = self.oracle.opts
 
-    def solve(self, pods: list[Pod]) -> Results:
+    def solve(self, pods: list[Pod], trace=None) -> Results:
         """Never raises UnsupportedBySolver.
 
         Per-pod partitioning (the round-2 "fallback cliff" fix): pods the
@@ -144,10 +144,32 @@ class HybridScheduler:
         the shared oracle and syncs the Topology's domain counts from the
         device, so the continuation packs into the same cluster picture.
         One odd pod no longer drags a 10k-pod batch onto the oracle.
+
+        `trace` (tracing.Trace, optional) records the routing decision
+        and oracle-fallback reasons as spans; threaded down into the
+        kernel driver's host phases. A standalone call owns its own
+        trace so every solve lands in the ring and the phase metrics.
         """
+        from karpenter_tpu import tracing
+
+        with tracing.maybe_trace(trace, "solve") as tr:
+            results = self._solve_traced(pods, tr)
+            tr.annotate(used_tpu=self.used_tpu)
+            return results
+
+    def _solve_traced(self, pods: list[Pod], tr) -> Results:
+        from karpenter_tpu import tracing
+
         if self.tpu is None:
             self.used_tpu = False
-            return self.oracle.solve(pods)
+            # a degrade decision made ABOVE this scheduler (the sidecar's
+            # mid-prewarm force_oracle) already recorded its reason on
+            # this trace; recording "forced" again would double-count the
+            # same solve in the per-reason fallback totals
+            if not any(s.name == "oracle_fallback" for s in tr.spans):
+                tracing.record_fallback(tr, "forced", "force_oracle scheduler")
+            with tr.span("oracle", pods=len(pods)):
+                return self.oracle.solve(pods)
 
         # Size-based routing (VERDICT r3 weak #2): below the measured
         # crossover a topology-free batch solves faster on the oracle than
@@ -166,7 +188,9 @@ class HybridScheduler:
                 f"small topology-free batch ({len(pods)} pods < crossover "
                 f"{self.opts.tpu_min_pods}) routed to oracle"
             )
-            return self.oracle.solve(pods)
+            tracing.record_fallback(tr, "small_batch", self.fallback_reason)
+            with tr.span("oracle", pods=len(pods)):
+                return self.oracle.solve(pods)
 
         from karpenter_tpu.solver.tpu_problem import pod_unsupported_reason
 
@@ -182,15 +206,19 @@ class HybridScheduler:
         if unsupported and not can_partition:
             self.used_tpu = False
             self.fallback_reason = first_reason
-            return self.oracle.solve(pods)
+            tracing.record_fallback(tr, "unsupported", first_reason or "")
+            with tr.span("oracle", pods=len(pods)):
+                return self.oracle.solve(pods)
         try:
-            results = self.tpu.solve(supported)
+            results = self.tpu.solve(supported, trace=tr)
         except UnsupportedBySolver as e:
             # encode_problem raises before mutating the oracle or the
             # shared Topology, so the oracle can run on the same state
             self.fallback_reason = str(e)
             self.used_tpu = False
-            return self.oracle.solve(pods)
+            tracing.record_fallback(tr, "unsupported", str(e))
+            with tr.span("oracle", pods=len(pods)):
+                return self.oracle.solve(pods)
         except Exception as e:
             # Last-resort guard (ISSUE: no unexpected TPU-path error may
             # propagate out of the reconcile loop). Unlike the typed
@@ -203,12 +231,14 @@ class HybridScheduler:
                 f"{type(e).__name__}: {e}"
             )
             SOLVER_FALLBACK.inc({"reason": "tpu_error"})
+            tracing.record_fallback(tr, "tpu_error", self.fallback_reason)
             _log.error(
                 "TPU path raised unexpectedly; re-solving on a pristine oracle",
                 error=f"{type(e).__name__}: {e}",
                 pods=len(pods),
             )
-            return self._pristine_oracle_solve(pods)
+            with tr.span("oracle", pods=len(pods)):
+                return self._pristine_oracle_solve(pods)
         self.used_tpu = True
         self.fallback_reason = None
         if not unsupported:
@@ -218,7 +248,11 @@ class HybridScheduler:
         self.fallback_reason = (
             f"{len(unsupported)} pod(s) continued on the oracle: {first_reason}"
         )
-        cont = self.oracle.solve(unsupported)
+        tracing.record_fallback(
+            tr, "partition_continuation", self.fallback_reason
+        )
+        with tr.span("oracle", pods=len(unsupported)):
+            cont = self.oracle.solve(unsupported)
         cont.pod_errors.update(results.pod_errors)
         cont.timed_out = cont.timed_out or results.timed_out
         return cont
@@ -258,30 +292,37 @@ def solve_in_process(
     options: Optional[SchedulerOptions] = None,
     cluster: Optional[ClusterSource] = None,
     force_oracle: bool = False,
+    trace=None,
 ) -> tuple[Results, HybridScheduler]:
     """THE in-process solve assembly: Topology + HybridScheduler, options
     threaded consistently. Every path that solves locally — the
     provisioning controller, the sidecar server, ResilientSolver's
     fallback — goes through here, so the three can never diverge on how
-    ignore_preferences / cluster state / views reach the scheduler."""
-    topology = Topology(
-        node_pools,
-        instance_types_by_pool,
-        pods,
-        cluster=cluster or ClusterSource(),
-        state_node_views=state_node_views,
-        ignore_preferences=bool(options and options.ignore_preferences),
-    )
-    scheduler = HybridScheduler(
-        node_pools,
-        instance_types_by_pool,
-        topology,
-        state_node_views,
-        daemonset_pods,
-        options,
-        force_oracle=force_oracle,
-    )
-    return scheduler.solve(pods), scheduler
+    ignore_preferences / cluster state / views reach the scheduler.
+    `trace` (tracing.Trace) joins the caller's solve trace; a standalone
+    call owns a local one."""
+    from karpenter_tpu import tracing
+
+    with tracing.maybe_trace(trace, "solve") as tr:
+        with tr.span("topology", pods=len(pods)):
+            topology = Topology(
+                node_pools,
+                instance_types_by_pool,
+                pods,
+                cluster=cluster or ClusterSource(),
+                state_node_views=state_node_views,
+                ignore_preferences=bool(options and options.ignore_preferences),
+            )
+        scheduler = HybridScheduler(
+            node_pools,
+            instance_types_by_pool,
+            topology,
+            state_node_views,
+            daemonset_pods,
+            options,
+            force_oracle=force_oracle,
+        )
+        return scheduler.solve(pods, trace=tr), scheduler
 
 
 # ---------------------------------------------------------------------------
@@ -458,9 +499,35 @@ class ResilientSolver:
         cluster: Optional[ClusterSource] = None,
         namespace_labels: Optional[dict] = None,
         force_oracle: bool = False,
+        trace=None,
     ) -> Results:
         """Never raises for solver-side faults; the in-process ladder is
-        always available as the floor."""
+        always available as the floor. `trace` (tracing.Trace) is the
+        provisioning round's trace; sidecar attempts record their span on
+        it and the wire client stamps its correlation id as the trace id,
+        joining the client- and server-side spans into one trace."""
+        from karpenter_tpu import tracing
+
+        with tracing.maybe_trace(trace, "resilient_solve") as tr:
+            return self._solve_traced(
+                node_pools, instance_types_by_pool, pods, state_node_views,
+                daemonset_pods, options, cluster, namespace_labels,
+                force_oracle, tr,
+            )
+
+    def _solve_traced(
+        self,
+        node_pools,
+        instance_types_by_pool,
+        pods,
+        state_node_views,
+        daemonset_pods,
+        options,
+        cluster,
+        namespace_labels,
+        force_oracle,
+        tr,
+    ) -> Results:
         if namespace_labels is None and cluster is not None:
             namespace_labels = cluster.namespace_labels
         # The wire deadline must COVER the server-side solve budget: a solve
@@ -475,25 +542,29 @@ class ResilientSolver:
             )
         if self.breaker.allow():
             try:
-                decoded = self.client.solve(
-                    node_pools,
-                    instance_types_by_pool,
-                    pods,
-                    state_node_views,
-                    daemonset_pods,
-                    options,
-                    force_oracle,
-                    namespace_labels,
-                    timeout=wire_timeout,
-                    # the FULL cluster slice (scheduled pods, node labels)
-                    # crosses the wire: the sidecar must count existing
-                    # anti-affinity/spread state exactly like in-process
-                    cluster=cluster,
-                )
+                with tr.span("sidecar", pods=len(pods)):
+                    decoded = self.client.solve(
+                        node_pools,
+                        instance_types_by_pool,
+                        pods,
+                        state_node_views,
+                        daemonset_pods,
+                        options,
+                        force_oracle,
+                        namespace_labels,
+                        timeout=wire_timeout,
+                        # the FULL cluster slice (scheduled pods, node
+                        # labels) crosses the wire: the sidecar must count
+                        # existing anti-affinity/spread state exactly like
+                        # in-process
+                        cluster=cluster,
+                        trace=tr,
+                    )
                 self.breaker.record_success()
                 SIDECAR_REQUESTS.inc({"outcome": "success"})
                 self.last_used = "sidecar"
                 self.fallback_reason = None
+                tr.annotate(solver="sidecar")
                 return self._to_results(decoded, pods)
             except Exception as e:
                 self.breaker.record_failure()
@@ -502,6 +573,11 @@ class ResilientSolver:
                 self.fallback_reason = (
                     f"sidecar solve failed ({type(e).__name__}: {e}); "
                     "degrading to in-process solver"
+                )
+                tr.event(
+                    "sidecar_failed",
+                    error=f"{type(e).__name__}: {e}",
+                    breaker=self.breaker.state,
                 )
                 self.log.warn(
                     "sidecar solve failed; degrading to in-process solver",
@@ -514,7 +590,8 @@ class ResilientSolver:
             self.fallback_reason = (
                 "sidecar circuit open; solving in-process during cooldown"
             )
-        return self._solve_in_process(
+            tr.event("circuit_open", breaker=self.breaker.state)
+        results = self._solve_in_process(
             node_pools,
             instance_types_by_pool,
             pods,
@@ -524,7 +601,10 @@ class ResilientSolver:
             cluster,
             namespace_labels,
             force_oracle,
+            trace=tr,
         )
+        tr.annotate(solver=self.last_used)
+        return results
 
     def _solve_in_process(
         self,
@@ -537,6 +617,7 @@ class ResilientSolver:
         cluster,
         namespace_labels,
         force_oracle,
+        trace=None,
     ) -> Results:
         results, scheduler = solve_in_process(
             node_pools,
@@ -547,6 +628,7 @@ class ResilientSolver:
             options,
             cluster=cluster or ClusterSource(namespace_labels=namespace_labels or {}),
             force_oracle=force_oracle,
+            trace=trace,
         )
         self.last_used = "tpu" if scheduler.used_tpu else "oracle"
         return results

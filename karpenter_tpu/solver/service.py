@@ -67,6 +67,7 @@ from typing import Optional
 import numpy as np
 
 from karpenter_tpu import logging as klog
+from karpenter_tpu import tracing
 from karpenter_tpu.api import codec
 from karpenter_tpu.solver.hybrid import solve_in_process
 from karpenter_tpu.solver.nodes import StateNodeView
@@ -720,7 +721,7 @@ class SolverServer:
                 )
                 continue
             try:
-                result = self._solve(payload)
+                result = self._solve(payload, req_id)
             except Exception as e:  # error frames, never a dead socket
                 self.log.warn("solve failed, answering ERROR", error=str(e))
                 self._send_response(
@@ -729,23 +730,44 @@ class SolverServer:
                 continue
             self._send_response(conn, KIND_RESULT, result, req_id)
 
-    def _solve(self, payload: bytes) -> bytes:
-        (
-            node_pools,
-            its_by_pool,
-            pods,
-            views,
-            daemons,
-            options,
-            force_oracle,
-            source,
-        ) = _decode_problem_request(payload)
+    def _solve(self, payload: bytes, req_id: int = 0) -> bytes:
+        # the server-side half of the solve trace: same wire correlation
+        # id as the client's trace, so /debug/solves/<id> shows both —
+        # client wire spans and server decode/solve/encode phases — as
+        # one logical trace (tracing module docstring)
+        tr = tracing.new_trace("solve", side="server")
+        if req_id:
+            tr.set_wire_id(req_id)
+        try:
+            result = self._solve_traced(payload, tr)
+        except BaseException:
+            tr.finish("error")
+            raise
+        tr.finish("ok")
+        return result
+
+    def _solve_traced(self, payload: bytes, tr) -> bytes:
+        with tr.span("wire_decode_request", bytes=len(payload)):
+            (
+                node_pools,
+                its_by_pool,
+                pods,
+                views,
+                daemons,
+                options,
+                force_oracle,
+                source,
+            ) = _decode_problem_request(payload)
         # mid-prewarm requests degrade to the (decision-identical) oracle:
         # the device path may still be compiling, and a solve must never
         # pay the compile wall nor race the prewarm for the jit caches
         degraded = not self.ready.is_set()
         if degraded:
             force_oracle = True
+            tracing.record_fallback(
+                tr, "prewarm_degraded",
+                "mid-prewarm solve served by the oracle fallback",
+            )
         results, scheduler = solve_in_process(
             node_pools,
             its_by_pool,
@@ -755,12 +777,16 @@ class SolverServer:
             options,
             cluster=source,
             force_oracle=force_oracle,
+            trace=tr,
         )
         with self._stats_lock:
             self.solves += 1
             if degraded:
                 self.oracle_degraded_solves += 1
-        return _encode_result(results, bool(scheduler.used_tpu), pods)
+        with tr.span("wire_encode_result"):
+            out = _encode_result(results, bool(scheduler.used_tpu), pods)
+        tr.annotate(pods=len(pods), used_tpu=bool(scheduler.used_tpu))
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -807,10 +833,19 @@ class SolverClient:
         self._rng = rng or random.Random()
         self._sleep = sleep
         self._sock: Optional[socket.socket] = None
-        self._next_id = 0
+        # correlation ids start at a RANDOM point in the u32 space: the id
+        # is a per-connection tripwire (the server just echoes it), but it
+        # doubles as the trace id on both sides — two clients (or one
+        # restarted control plane) both counting 1, 2, 3... would collide
+        # in the sidecar's trace ring and /debug/solves/<id> would merge
+        # unrelated solves into one "joined" trace
+        self._next_id = self._rng.randrange(0, 0xFFFFFFFF)
         # observability for the breaker layer / tests
         self.reconnects = 0
         self.poisoned = 0
+        # correlation id of the most recent frame sent: solve() stamps it
+        # onto the caller's trace so client and sidecar spans join
+        self.last_req_id = 0
 
     # -- connection management --------------------------------------------
 
@@ -867,6 +902,7 @@ class SolverClient:
                 self._ensure_connected(deadline)
                 self._next_id = (self._next_id % 0xFFFFFFFF) + 1
                 req_id = self._next_id
+                self.last_req_id = req_id
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise socket.timeout("deadline exceeded before send")
@@ -923,19 +959,30 @@ class SolverClient:
         namespace_labels: Optional[dict] = None,
         timeout: Optional[float] = None,
         cluster=None,
+        trace=None,
     ) -> dict:
-        payload = encode_problem_request(
-            node_pools,
-            instance_types_by_pool,
-            pods,
-            state_node_views,
-            daemonset_pods,
-            options,
-            force_oracle,
-            namespace_labels,
-            cluster,
-        )
-        kind, resp = self._roundtrip(KIND_SOLVE, payload, timeout)
+        """`trace` (tracing.Trace, optional): wire-phase spans land on it
+        and the SOLVE frame's correlation id becomes the trace id, joining
+        this client-side trace with the sidecar's server-side one."""
+        with tracing.span_of(trace, "wire_encode", pods=len(pods)):
+            payload = encode_problem_request(
+                node_pools,
+                instance_types_by_pool,
+                pods,
+                state_node_views,
+                daemonset_pods,
+                options,
+                force_oracle,
+                namespace_labels,
+                cluster,
+            )
+        with tracing.span_of(trace, "wire_roundtrip", bytes=len(payload)):
+            kind, resp = self._roundtrip(KIND_SOLVE, payload, timeout)
+        if trace is not None:
+            # the correlation id of the attempt that ANSWERED (retries
+            # re-id; last_req_id tracks the final frame on the wire)
+            trace.set_wire_id(self.last_req_id)
         if kind == KIND_ERROR:
             raise SolverError(resp.decode())
-        return decode_result(json.loads(resp), pods)
+        with tracing.span_of(trace, "wire_decode", bytes=len(resp)):
+            return decode_result(json.loads(resp), pods)

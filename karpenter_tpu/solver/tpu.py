@@ -337,6 +337,21 @@ def _slice_rows(compact, u2: int):
     return _slice_rows_cached(compact, u2=u2)
 
 
+def _tree_nbytes(tree) -> int:
+    """Total bytes across a pytree of device arrays — the table-upload
+    accounting behind karpenter_solve_upload_bytes_total (CLAUDE.md: the
+    host<->device tunnel charges per byte, so the uploads are the number
+    to watch before op counts)."""
+    import jax
+
+    return int(
+        sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+    )
+
+
 def _popcount_rows(seg: np.ndarray) -> np.ndarray:
     return np.unpackbits(
         seg.astype("<u4").view(np.uint8), axis=-1
@@ -474,21 +489,33 @@ class TpuScheduler:
 
     # -- solve ----------------------------------------------------------
 
-    def solve(self, pods: list[Pod]) -> Results:
+    def solve(self, pods: list[Pod], trace=None) -> Results:
         """May raise UnsupportedBySolver; Solver wrappers catch and fall
         back to the oracle. The persistent compile cache is configured by
-        the solver package import (jaxsetup.ensure_compilation_cache)."""
+        the solver package import (jaxsetup.ensure_compilation_cache).
+
+        `trace` is an optional tracing.Trace the caller threads down from
+        the controller (explicit context object — never contextvars, and
+        every span is host-side, so the instrumentation cannot retrace a
+        compiled program). Standalone solves own a local trace so phase
+        metrics populate on EVERY solve; last_profile exposes it."""
+        from karpenter_tpu import tracing
+
+        with tracing.maybe_trace(trace, "tpu_solve") as tr:
+            self.last_profile = tr
+            return self._solve_traced(pods, tr)
+
+    def _solve_traced(self, pods: list[Pod], prof) -> Results:
         import jax  # already imported by the package init; cheap rebind
 
-        from karpenter_tpu.profiling import SolveProfile
+        from karpenter_tpu import tracing
 
-        prof = self.last_profile = SolveProfile()
         if not pods:
             return Results(
                 new_node_claims=[], existing_nodes=self.oracle.existing_nodes,
                 pod_errors={},
             )
-        with prof.phase("encode"):
+        with prof.span("encode", pods=len(pods)):
             problem = encode_problem(self.oracle, pods)
         deadline = (
             time_mod.monotonic() + self.opts.timeout_seconds
@@ -501,15 +528,18 @@ class TpuScheduler:
         # identical pods contiguous for the run kernel. Sort columns come
         # from the per-class tables (one PodData per class, shared by every
         # pod of the class); only timestamps/uids are gathered per pod.
-        with prof.phase("order"):
+        with prof.span("order"):
             order = self._order_pods(problem)
 
         from karpenter_tpu.solver import tpu_kernel as K
         from karpenter_tpu.solver import tpu_runs as KR
 
-        with prof.phase("upload"):
+        with prof.span("upload"):
             tb = self._tables(problem)  # also sets self._typeok
             self._upload_pod_tables(problem)
+            upload_bytes = _tree_nbytes(tb) + _tree_nbytes(self._dev_tables)
+        prof.count("upload_bytes", by=upload_bytes)
+        tracing.SOLVE_UPLOAD_BYTES.inc(by=upload_bytes)
         gates_ok = _bulk_gates(problem, strict_types=False)
         self._bulk_flags_c = _bulk_class_flags(problem, gates_ok)
         # trace-time static: with no relaxable requirement classes the
@@ -536,6 +566,15 @@ class TpuScheduler:
             # means a full re-solve — don't undersize its slot pool
             div = min(div, 4)
         N = min(_pow2(max(64, (len(pods) + div - 1) // div)), _pow2(len(pods)))
+        # bucket selection is a decision, not a duration: record it as a
+        # marker so the trace waterfall shows which compiled-shape family
+        # (path x claim-slot rung x relax) this solve rode
+        path = "runs" if use_runs else "scan"
+        prof.event("bucket", claim_slots=N, path=path, relax=relax)
+        tiers_beyond_0 = int(problem.ntiers_r.max(initial=1)) - 1 if relax else 0
+        if tiers_beyond_0:
+            prof.count("relax_tiers", by=tiers_beyond_0)
+            tracing.SOLVE_RELAX_TIERS.inc(by=tiers_beyond_0)
         while True:
             st = self._init_state(problem, N)
             seq = jax.numpy.zeros(N, jax.numpy.int32)
@@ -556,36 +595,43 @@ class TpuScheduler:
                 offset = 0
                 while True:
                     batch = pending[offset:]
-                    if use_runs:
-                        with prof.phase("pod_xs"):
-                            xs, idx_d, n_d = self._pod_xs_with_idx(problem, batch)
-                            rx = self._run_x(xs, idx_d, n_d)
-                        with prof.phase("kernel"):
-                            (
-                                st, seq, next_seq, got_kinds, got_slots,
-                                got_over, iters, got_ptr,
-                            ) = KR.solve_runs(
-                                tb, st, rx, seq, next_seq,
-                                jax.numpy.int32(len(batch)),
-                                relax=relax,
+                    # one device dispatch: upload the round's index array,
+                    # run the kernel, fetch the verdicts. The pod_xs/
+                    # kernel/fetch sub-spans are per-dispatch detail —
+                    # individually recorded only behind the profiling gate
+                    with prof.span("dispatch", path=path):
+                        if use_runs:
+                            with prof.span("pod_xs", detail=True):
+                                xs, idx_d, n_d = self._pod_xs_with_idx(problem, batch)
+                                rx = self._run_x(xs, idx_d, n_d)
+                            with prof.span("kernel", detail=True):
+                                (
+                                    st, seq, next_seq, got_kinds, got_slots,
+                                    got_over, iters, got_ptr,
+                                ) = KR.solve_runs(
+                                    tb, st, rx, seq, next_seq,
+                                    jax.numpy.int32(len(batch)),
+                                    relax=relax,
+                                )
+                            self.last_iters = iters
+                        else:
+                            with prof.span("pod_xs", detail=True):
+                                xs = self._pod_xs(problem, batch)
+                            with prof.span("kernel", detail=True):
+                                st, got_kinds, got_slots, got_over = K.solve_scan(
+                                    tb, st, xs, relax=relax
+                                )
+                                got_ptr = None
+                        # one batched device->host fetch (the tunnel
+                        # charges per call)
+                        with prof.span("fetch", detail=True):
+                            fetched = jax.device_get(
+                                (got_kinds, got_slots, got_over)
+                                if got_ptr is None
+                                else (got_kinds, got_slots, got_over, got_ptr)
                             )
-                        self.last_iters = iters
-                    else:
-                        with prof.phase("pod_xs"):
-                            xs = self._pod_xs(problem, batch)
-                        with prof.phase("kernel"):
-                            st, got_kinds, got_slots, got_over = K.solve_scan(
-                                tb, st, xs, relax=relax
-                            )
-                            got_ptr = None
-                    # one batched device->host fetch (the tunnel charges
-                    # per call)
-                    with prof.phase("fetch"):
-                        fetched = jax.device_get(
-                            (got_kinds, got_slots, got_over)
-                            if got_ptr is None
-                            else (got_kinds, got_slots, got_over, got_ptr)
-                        )
+                    prof.count("dispatches")
+                    tracing.SOLVE_DISPATCHES.inc({"path": path})
                     got_kinds, got_slots, got_over = fetched[:3]
                     if bool(got_over) and got_ptr is None:
                         overflowed = True  # scan path: re-solve from scratch
@@ -602,8 +648,10 @@ class TpuScheduler:
                             i for i, k in zip(done, got_kinds[:n_done])
                             if k == K.KIND_FAIL
                         ]
-                        with prof.phase("upload"):
+                        with prof.span("regrow"):
                             st, seq = self._grow(problem, st, seq, N)
+                        prof.count("regrows")
+                        tracing.SOLVE_REGROWS.inc()
                         N *= 2
                         offset += n_done
                         continue
@@ -624,7 +672,11 @@ class TpuScheduler:
                 break
             N *= 2  # scan-path slots exhausted: re-solve with room
 
-        with prof.phase("decode"):
+        prof.annotate(
+            pods=len(pods), path=path, relax=relax, claim_slots=N,
+            timed_out=timed_out,
+        )
+        with prof.span("decode"):
             return self._decode(problem, st, kinds, slots, timed_out)
 
     def _order_pods(self, p: EncodedProblem) -> list:

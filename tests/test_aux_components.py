@@ -379,17 +379,22 @@ def test_pprof_endpoints_gated_by_flag():
 
 
 def test_solve_profile_phases():
-    from karpenter_tpu.profiling import SolveProfile
+    """The per-solve phase breakdown now rides tracing.Trace (the
+    SolveProfile successor): phases accumulate per name and render as a
+    share table."""
+    from karpenter_tpu import tracing
 
-    prof = SolveProfile()
-    with prof.phase("a"):
+    prof = tracing.new_trace("unit")
+    with prof.span("a"):
         pass
-    with prof.phase("b"):
-        with prof.phase("a"):
+    with prof.span("b"):
+        with prof.span("a"):
             pass
+    prof.finish()
     out = prof.render()
     assert "a" in out and "b" in out
     assert prof.phases["a"] >= 0.0
+    assert set(prof.top_phases()) == {"a", "b"}
 
 
 def test_leader_election_lease_lifecycle(tmp_path):

@@ -417,9 +417,9 @@ def test_concurrent_connections_are_served(server, monkeypatch):
     """One slow solve must not head-of-line-block a second connection."""
     original = SolverServer._solve
 
-    def slow(self, payload):
+    def slow(self, payload, req_id=0):
         time.sleep(1.0)
-        return original(self, payload)
+        return original(self, payload, req_id)
 
     monkeypatch.setattr(SolverServer, "_solve", slow)
     pools, ibp, pods = _problem(2)
@@ -445,9 +445,9 @@ def test_concurrent_connections_are_served(server, monkeypatch):
 def test_graceful_drain_flushes_inflight_solve(server, monkeypatch):
     original = SolverServer._solve
 
-    def slow(self, payload):
+    def slow(self, payload, req_id=0):
         time.sleep(0.5)
-        return original(self, payload)
+        return original(self, payload, req_id)
 
     monkeypatch.setattr(SolverServer, "_solve", slow)
     pools, ibp, pods = _problem(2)

@@ -444,6 +444,67 @@ def test_registered_markers_parsed_from_pyproject():
 
 
 # ---------------------------------------------------------------------------
+# metric-naming
+
+
+def test_metric_naming_flags_prefix_help_and_nonliteral():
+    findings = run_rule(
+        "metric-naming",
+        """
+        from karpenter_tpu import metrics
+
+        BAD_PREFIX = metrics.REGISTRY.counter(
+            "solver_things_total", "Things.",
+        )
+        NO_HELP = metrics.REGISTRY.gauge("karpenter_things", "")
+        COMPUTED_HELP = metrics.REGISTRY.gauge("karpenter_other", HELP_VAR)
+        name = "karpenter_" + kind
+        DYNAMIC = metrics.REGISTRY.histogram(name, "Dynamic.")
+        """,
+        "karpenter_tpu/solver/x.py",
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 4
+    assert "karpenter_ namespace prefix" in msgs
+    assert "non-empty help" in msgs
+    assert "string literal" in msgs
+
+
+def test_metric_naming_flags_duplicates_across_files():
+    rule = next(r for r in all_rules() if r.id == "metric-naming")
+    import textwrap
+
+    cfg = Config(repo_root=REPO_ROOT)
+    src_a = 'X = REGISTRY.counter("karpenter_dup_total", "First.")\n'
+    src_b = 'Y = REGISTRY.counter("karpenter_dup_total", "Second.")\n'
+    a = FileContext("a.py", "karpenter_tpu/a.py", textwrap.dedent(src_a), cfg)
+    b = FileContext("b.py", "karpenter_tpu/b.py", textwrap.dedent(src_b), cfg)
+    assert rule.run(a) == []
+    dups = rule.run(b)
+    assert len(dups) == 1 and "already registered at karpenter_tpu/a.py:1" in dups[0].message
+
+
+def test_metric_naming_allows_clean_registration_and_foreign_registries():
+    findings = run_rule(
+        "metric-naming",
+        """
+        from karpenter_tpu import metrics
+        from karpenter_tpu.metrics import Registry
+
+        OK = metrics.REGISTRY.counter(
+            "karpenter_good_total",
+            "A well-formed registration.",
+            ("reason",),
+        )
+        r = Registry()
+        scratch = r.counter("not_karpenter", "")  # private registry: out of scope
+        """,
+        "karpenter_tpu/controllers/x.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline mechanics
 
 
@@ -635,6 +696,7 @@ def test_every_rule_has_fixture_coverage_here():
         "cache-invalidation",
         "citation-check",
         "pytest-markers",
+        "metric-naming",
     }
     assert {r.id for r in all_rules()} == covered
 

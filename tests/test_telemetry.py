@@ -1,0 +1,611 @@
+"""End-to-end solve telemetry (ISSUE 10): the tracing layer, the metric
+exposition surface, and the probe endpoints.
+
+Covers:
+- karpenter_tpu.tracing unit behavior (spans, ring, detail gate, phase
+  aggregation, bounded overhead);
+- a Prometheus text-format lint of metrics.Registry.render() — HELP/TYPE
+  ordering, name charset, label escaping, histogram bucket monotonicity
+  and +Inf == _count;
+- ProbeServer /debug/solves + /debug/solves/<id> (including under
+  concurrent solves) and the /debug/pprof/profile seconds clamp;
+- the sidecar acceptance path (test_service_faults.py-style harness):
+  one solve through ResilientSolver with the sidecar active yields a
+  single logical trace whose client- and server-side halves share the
+  wire correlation id and cover encode/upload/dispatch/decode, and the
+  oracle-degrade paths record the fallback reason as a span + labeled
+  counter;
+- the docs/observability.md metric-catalog drift test.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import metrics, tracing
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.controllers import probes as probes_mod
+from karpenter_tpu.controllers.probes import ProbeServer
+from karpenter_tpu.solver.hybrid import ResilientSolver, solve_in_process
+from karpenter_tpu.solver.oracle import SchedulerOptions
+from karpenter_tpu.solver.service import SolverServer
+from karpenter_tpu.testing import fixtures
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _problem(n=6):
+    fixtures.reset_rng(11)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    pods = fixtures.make_diverse_pods(n)
+    return pools, {"default": its}, pods
+
+
+def _get(srv, path, timeout=15):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=timeout
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# tracing unit behavior
+
+
+def test_trace_spans_phases_and_ring():
+    tracing.RING.clear()
+    tr = tracing.new_trace("unit")
+    with tr.span("encode", pods=3):
+        pass
+    with tr.span("dispatch"):
+        with tr.span("kernel", detail=True):
+            pass
+    tr.event("oracle_fallback", reason="unsupported")
+    tr.count("dispatches")
+    tr.annotate(pods=3)
+    tr.finish("ok")
+    # detail off: the kernel sub-span folds into phases but records no Span
+    names = [s.name for s in tr.spans]
+    assert names == ["encode", "dispatch", "oracle_fallback"]
+    assert set(tr.phases) == {"encode", "dispatch", "kernel"}
+    # top_phases excludes nested names — safe to sum for shares
+    assert set(tr.top_phases()) == {"encode", "dispatch"}
+    assert tr.counts == {"dispatches": 1}
+    assert tr.outcome == "ok" and tr.total_seconds >= 0.0
+    got = tracing.RING.find(tr.trace_id)
+    assert got == [tr]
+    # finish is idempotent: no double ring push or double observe
+    tr.finish("error")
+    assert tr.outcome == "ok"
+    assert len(tracing.RING.find(tr.trace_id)) == 1
+    d = tr.to_dict()
+    assert d["spans"][0]["attrs"] == {"pods": 3}
+    assert "kernel" in d["phases"]
+
+
+def test_detail_gate_records_subspans():
+    tracing.set_detail(True)
+    try:
+        tr = tracing.new_trace("unit")
+        with tr.span("dispatch"):
+            with tr.span("kernel", detail=True):
+                pass
+        tr.finish()  # sorts spans into waterfall (start) order
+        assert [s.name for s in tr.spans] == ["dispatch", "kernel"]
+        assert [s.depth for s in tr.spans] == [0, 1]
+    finally:
+        tracing.set_detail(False)
+
+
+def test_span_cap_degrades_to_aggregates():
+    tr = tracing.new_trace("unit")
+    for _ in range(tracing.MAX_SPANS + 10):
+        with tr.span("tick"):
+            pass
+    assert len(tr.spans) == tracing.MAX_SPANS
+    assert tr.truncated
+    # the aggregate kept counting past the cap
+    assert tr.phases["tick"] > 0.0
+    tr.finish()
+
+
+def test_wire_id_adoption():
+    tr = tracing.new_trace("unit", side="client")
+    tr.set_wire_id(42)
+    assert tr.trace_id == "w42"
+    tr.finish()
+    assert tracing.RING.find("w42")[-1] is tr
+
+
+def test_trace_overhead_bounded():
+    """The default-tier cost of a fully instrumented solve (6 top-level
+    phases + 40 dispatches with a folded detail sub-span each + finish)
+    must stay far below the 2% bench acceptance band — docs/
+    observability.md quotes this number."""
+    n = 100
+    t0 = time.monotonic()
+    for _ in range(n):
+        tr = tracing.new_trace("bench")
+        for i in range(6):
+            with tr.span(f"p{i}"):
+                pass
+        for _ in range(40):
+            with tr.span("dispatch"):
+                with tr.span("kernel", detail=True):
+                    pass
+        tr.count("dispatches", by=40)
+        tr.finish()
+    per_solve = (time.monotonic() - t0) / n
+    # generous ceiling for a loaded CI box; measured ~80 µs
+    assert per_solve < 0.005, f"tracing costs {per_solve * 1e6:.0f} µs/solve"
+
+
+def test_trace_events_shared_with_ir_tier():
+    """Satellite: the compile/retrace counters moved to the shared
+    telemetry module; the IR tier re-exports the SAME object."""
+    from karpenter_tpu.analysis import ir
+
+    assert ir.trace_events is tracing.trace_events
+    assert ir._COUNTS is tracing._COUNTS
+
+
+def test_jax_compile_events_surface_as_metrics():
+    """Runtime solves surface backend_compiles/cache_hits as metrics, not
+    only inside graftlint runs: a fresh jit program bumps the listener's
+    counter metric."""
+    import jax
+    import jax.numpy as jnp
+
+    tracing.install_compile_listener()
+    before = tracing.JAX_COMPILE_EVENTS.value({"event": "traces"})
+    jax.jit(lambda x: x * 3 + 1)(jnp.arange(7))  # fresh lambda: new trace
+    assert tracing.JAX_COMPILE_EVENTS.value({"event": "traces"}) >= before + 1
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition lint
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s(\S+)$"
+)
+_LABELS_RE = re.compile(
+    r"\{(?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\"|\\\\|\\n)*\"(?:,|(?=\})))*\}\Z"
+)
+
+
+def lint_prometheus(text: str) -> None:
+    """Assert `text` is well-formed Prometheus text exposition: HELP then
+    TYPE precede a family's samples, names are legal, label blocks parse
+    with escaping, histogram buckets are cumulative/monotone with le
+    ascending and +Inf == _count."""
+    helped: set[str] = set()
+    types: dict[str, str] = {}
+    # family -> base-labels -> list[(le, count)], plus _sum/_count values
+    buckets: dict[tuple, list[tuple[float, float]]] = {}
+    counts: dict[tuple, float] = {}
+    for ln in text.rstrip("\n").split("\n"):
+        if ln.startswith("# HELP "):
+            name = ln.split(" ", 3)[2]
+            assert _NAME_RE.match(name), f"bad HELP name: {ln!r}"
+            assert name not in helped, f"duplicate HELP for {name}"
+            assert name not in types, f"HELP after TYPE for {name}"
+            helped.add(name)
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split(" ")
+            name, kind = parts[2], parts[3]
+            assert kind in ("counter", "gauge", "histogram"), ln
+            assert name in helped, f"TYPE without HELP: {ln!r}"
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+            continue
+        assert not ln.startswith("#"), f"unknown comment line: {ln!r}"
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"unparsable sample line: {ln!r}"
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        val = float(value)  # must parse
+        if labels:
+            assert _LABELS_RE.match(labels), (
+                f"label block fails escaping/charset lint: {ln!r}"
+            )
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                family = base
+                break
+        assert family in types, f"sample before TYPE: {ln!r}"
+        if types[family] == "histogram" and name.endswith("_bucket"):
+            le_m = re.search(r'le="([^"]*)"', labels)
+            assert le_m, f"histogram bucket without le: {ln!r}"
+            le = float("inf") if le_m.group(1) == "+Inf" else float(le_m.group(1))
+            stripped = re.sub(r',?le="[^"]*"', "", labels)
+            base_labels = (family, "" if stripped == "{}" else stripped)
+            buckets.setdefault(base_labels, []).append((le, val))
+        if types[family] == "histogram" and name.endswith("_count"):
+            counts[(family, labels)] = val
+    for (family, base_labels), series in buckets.items():
+        les = [le for le, _ in series]
+        vals = [v for _, v in series]
+        assert les == sorted(les), f"{family}{base_labels}: le not ascending"
+        assert les and les[-1] == float("inf"), f"{family}: missing +Inf"
+        assert all(
+            a <= b for a, b in zip(vals, vals[1:])
+        ), f"{family}{base_labels}: bucket counts not monotone"
+        cnt = counts.get((family, base_labels))
+        assert cnt is not None and cnt == vals[-1], (
+            f"{family}{base_labels}: +Inf bucket != _count"
+        )
+
+
+def test_registry_render_passes_format_lint():
+    # populate a few series first so the lint sees real samples
+    tracing.SOLVE_PHASE_SECONDS.observe(0.01, {"phase": "encode"})
+    tracing.SOLVE_FALLBACKS.inc({"reason": "unsupported"})
+    lint_prometheus(metrics.REGISTRY.render())
+
+
+def test_label_and_help_escaping():
+    r = metrics.Registry()
+    c = r.counter(
+        "karpenter_escape_total",
+        'help with "quotes", a \\ backslash\nand a newline',
+        ("reason",),
+    )
+    evil = 'fail: "quoted" \\ back\nslash'
+    c.inc({"reason": evil})
+    h = r.histogram("karpenter_escape_seconds", "H.", ("reason",))
+    h.observe(0.2, {"reason": evil})
+    text = r.render()
+    assert "\\n" in text and '\\"' in text
+    lint_prometheus(text)
+    # the escaped value round-trips: one sample line carries the value 1
+    line = next(
+        ln for ln in text.splitlines() if ln.startswith("karpenter_escape_total{")
+    )
+    assert line.endswith(" 1.0")
+
+
+def test_histogram_monotone_under_mixed_observations():
+    r = metrics.Registry()
+    h = r.histogram("karpenter_mono_seconds", "H.", buckets=[0.1, 1, 10])
+    for v in (0.05, 0.5, 5.0, 50.0, 0.05):
+        h.observe(v)
+    lint_prometheus(r.render())
+    assert h.count() == 5
+
+
+# ---------------------------------------------------------------------------
+# probe endpoints
+
+
+def test_debug_solves_endpoints():
+    tracing.RING.clear()
+    tr = tracing.new_trace("unit")
+    with tr.span("encode"):
+        pass
+    tr.set_wire_id(777)
+    tr.finish()
+    srv = ProbeServer(None, None)
+    srv.start()
+    try:
+        code, body = _get(srv, "/debug/solves")
+        assert code == 200
+        listing = json.loads(body)
+        assert listing and listing[0]["id"] == "w777"
+        assert "spans" not in listing[0]  # summaries only
+        code, body = _get(srv, "/debug/solves/w777")
+        assert code == 200
+        detail = json.loads(body)
+        assert [s["name"] for s in detail["traces"][0]["spans"]] == ["encode"]
+        code, _ = _get(srv, "/debug/solves/nosuch")
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+def test_debug_solves_under_concurrent_solves():
+    """The ring mutates while /debug/solves renders: every response must
+    stay parseable JSON with a 200 — no torn snapshots."""
+    tracing.RING.clear()
+    pools, ibp, pods = _problem(3)
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def solver_loop():
+        while not stop.is_set():
+            try:
+                solve_in_process(pools, ibp, pods, force_oracle=True)
+            except BaseException as e:  # surfaces in the assert below
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=solver_loop, daemon=True) for _ in range(3)]
+    srv = ProbeServer(None, None)
+    srv.start()
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        seen = 0
+        while time.monotonic() < deadline and seen < 20:
+            code, body = _get(srv, "/debug/solves")
+            assert code == 200
+            json.loads(body)  # must always parse
+            seen += 1
+        assert seen >= 20
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        srv.stop()
+    assert not errors, errors
+    assert tracing.RING.snapshot(), "concurrent solves landed no traces"
+
+
+def test_probe_profiling_gate_flips_trace_detail():
+    assert not tracing.detail_enabled()
+    srv = ProbeServer(None, None, enable_profiling=True)
+    srv.start()
+    try:
+        assert tracing.detail_enabled()
+    finally:
+        srv.stop()
+    assert not tracing.detail_enabled()
+
+
+def test_pprof_profile_seconds_clamped_and_validated(monkeypatch):
+    """Satellite: /debug/pprof/profile?seconds=N clamps to
+    MAX_PROFILE_SECONDS and 400s non-numeric/non-positive input — a
+    handler thread must never block for whatever the query string says."""
+    monkeypatch.setattr(probes_mod, "MAX_PROFILE_SECONDS", 0.2)
+    srv = ProbeServer(None, None, enable_profiling=True)
+    srv.start()
+    try:
+        t0 = time.monotonic()
+        code, body = _get(srv, "/debug/pprof/profile?seconds=30&top=1")
+        took = time.monotonic() - t0
+        assert code == 200 and "samples:" in body
+        assert took < 5.0, f"clamp did not hold: {took:.1f}s"
+        assert _get(srv, "/debug/pprof/profile?seconds=abc")[0] == 400
+        assert _get(srv, "/debug/pprof/profile?seconds=-3")[0] == 400
+        assert _get(srv, "/debug/pprof/profile?seconds=nan")[0] == 400
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fallback reasons: span + labeled counter
+
+
+def test_small_batch_fallback_recorded_as_span_and_counter():
+    tracing.RING.clear()
+    pools, ibp, pods = _problem(4)
+    before = tracing.SOLVE_FALLBACKS.value({"reason": "small_batch"})
+    results, sched = solve_in_process(pools, ibp, pods)  # below crossover
+    assert sched.used_tpu is False
+    assert tracing.SOLVE_FALLBACKS.value({"reason": "small_batch"}) == before + 1
+    tr = tracing.RING.snapshot()[-1]
+    spans = {s.name: s for s in tr.spans}
+    assert spans["oracle_fallback"].attrs["reason"] == "small_batch"
+    assert "oracle" in spans
+    assert tr.attrs.get("used_tpu") is False
+
+
+def test_forced_oracle_fallback_recorded():
+    tracing.RING.clear()
+    pools, ibp, pods = _problem(3)
+    before = tracing.SOLVE_FALLBACKS.value({"reason": "forced"})
+    solve_in_process(pools, ibp, pods, force_oracle=True)
+    assert tracing.SOLVE_FALLBACKS.value({"reason": "forced"}) == before + 1
+    tr = tracing.RING.snapshot()[-1]
+    assert any(
+        s.name == "oracle_fallback" and s.attrs.get("reason") == "forced"
+        for s in tr.spans
+    )
+
+
+# ---------------------------------------------------------------------------
+# the sidecar acceptance path (fault-harness style)
+
+
+@pytest.mark.faults
+@pytest.mark.hard_timeout(240)
+def test_sidecar_solve_yields_one_joined_trace():
+    """Acceptance: one solve through ResilientSolver with the sidecar
+    active yields a single logical trace — the client- and server-side
+    halves share the wire correlation id, and together they cover
+    encode/upload/dispatch/decode."""
+    tracing.RING.clear()
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SolverServer(path)
+    srv.start()
+    try:
+        rs = ResilientSolver(socket_path=path)
+        pools, ibp, pods = _problem(6)
+        # tpu_min_pods=0: the sidecar's solve must ride the kernel so the
+        # server half carries the encode/upload/dispatch/decode phases
+        results = rs.solve(
+            pools, ibp, pods, options=SchedulerOptions(tpu_min_pods=0)
+        )
+        assert rs.last_used == "sidecar"
+        assert results.new_node_claims
+    finally:
+        srv.stop()
+    wired: dict[str, list] = {}
+    for t in tracing.RING.snapshot():
+        if t.trace_id.startswith("w"):
+            wired.setdefault(t.trace_id, []).append(t)
+    pairs = [v for v in wired.values() if len(v) == 2]
+    assert len(pairs) == 1, f"expected one joined trace, got {wired}"
+    halves = pairs[0]
+    sides = {t.side for t in halves}
+    assert sides == {"local", "server"}
+    client = next(t for t in halves if t.side == "local")
+    server = next(t for t in halves if t.side == "server")
+    assert client.outcome == "ok" and server.outcome == "ok"
+    client_names = {s.name for s in client.spans}
+    server_names = {s.name for s in server.spans}
+    assert {"sidecar", "wire_encode", "wire_roundtrip", "wire_decode"} <= client_names
+    assert {
+        "wire_decode_request", "encode", "upload", "dispatch", "decode",
+        "wire_encode_result",
+    } <= server_names
+    assert server.attrs.get("used_tpu") is True
+    assert server.counts.get("dispatches", 0) >= 1
+    assert server.counts.get("upload_bytes", 0) > 0
+
+
+@pytest.mark.faults
+@pytest.mark.hard_timeout(120)
+def test_mid_prewarm_oracle_degrade_recorded():
+    """The oracle-degrade path: a solve hitting a mid-prewarm sidecar is
+    served by the oracle fallback, and the degrade is a span on the
+    server-side trace plus the labeled counter."""
+    tracing.RING.clear()
+    release = threading.Event()
+    path = tempfile.mktemp(suffix=".sock")
+    srv = SolverServer(
+        path, prewarm=True, prewarm_fn=lambda stop: release.wait(30)
+    )
+    srv.start()
+    try:
+        before = tracing.SOLVE_FALLBACKS.value({"reason": "prewarm_degraded"})
+        rs = ResilientSolver(socket_path=path)
+        pools, ibp, pods = _problem(4)
+        results = rs.solve(pools, ibp, pods)
+        assert rs.last_used == "sidecar"
+        assert (
+            tracing.SOLVE_FALLBACKS.value({"reason": "prewarm_degraded"})
+            == before + 1
+        )
+        server = next(
+            t for t in tracing.RING.snapshot() if t.side == "server"
+        )
+        fallbacks = [s for s in server.spans if s.name == "oracle_fallback"]
+        assert any(
+            s.attrs.get("reason") == "prewarm_degraded" for s in fallbacks
+        )
+        assert server.attrs.get("used_tpu") is False
+    finally:
+        release.set()
+        srv.stop()
+
+
+@pytest.mark.faults
+@pytest.mark.hard_timeout(120)
+def test_dead_sidecar_degrade_keeps_one_trace():
+    """Sidecar unreachable: the trace stays one-sided (no server half),
+    records the sidecar_failed marker, and the in-process fallback's
+    phases land on the SAME trace."""
+    tracing.RING.clear()
+    rs = ResilientSolver(
+        socket_path=tempfile.mktemp(suffix=".sock"),
+        request_timeout_seconds=0.5,
+    )
+    rs.client.max_retries = 0
+    pools, ibp, pods = _problem(4)
+    results = rs.solve(pools, ibp, pods)
+    assert rs.last_used in ("tpu", "oracle")
+    traces = tracing.RING.snapshot()
+    assert len(traces) == 1
+    tr = traces[0]
+    names = [s.name for s in tr.spans]
+    assert "sidecar_failed" in names
+    assert "oracle" in names or "dispatch" in names  # in-process floor ran
+    assert tr.attrs.get("solver") == rs.last_used
+
+
+# ---------------------------------------------------------------------------
+# provisioning round trace
+
+
+def test_provisioner_round_lands_one_trace():
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.operator import Operator
+
+    tracing.RING.clear()
+    op = Operator(clock=FakeClock(), force_oracle=True)
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    fixtures.reset_rng(5)
+    for p in fixtures.make_generic_pods(3):
+        op.kube.create("Pod", p)
+    op.step(2.0)
+    op.step(2.0)
+    op.stop()
+    rounds = [t for t in tracing.RING.snapshot() if t.kind == "provisioning"]
+    assert rounds, "provisioning reconcile produced no trace"
+    tr = rounds[-1]
+    assert tr.outcome == "ok"
+    names = {s.name for s in tr.spans}
+    assert "build_inputs" in names and "topology" in names
+    assert tr.attrs.get("solver") == "oracle"
+    assert tr.attrs.get("pods") == 3
+
+
+# ---------------------------------------------------------------------------
+# metric catalog drift (satellite): every registration appears in
+# docs/observability.md, and the doc names nothing unregistered
+
+
+def _registered_metric_names() -> set[str]:
+    names: set[str] = set()
+    for root, dirs, files in os.walk(os.path.join(REPO_ROOT, "karpenter_tpu")):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn), encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("counter", "gauge", "histogram")
+                ):
+                    continue
+                recv = node.func.value
+                if not (
+                    (isinstance(recv, ast.Name) and recv.id == "REGISTRY")
+                    or (
+                        isinstance(recv, ast.Attribute)
+                        and recv.attr == "REGISTRY"
+                    )
+                ):
+                    continue
+                if node.args and isinstance(node.args[0], ast.Constant):
+                    names.add(node.args[0].value)
+    return names
+
+
+def test_metric_catalog_drift():
+    registered = _registered_metric_names()
+    assert registered, "source scan found no registrations"
+    with open(os.path.join(REPO_ROOT, "docs", "observability.md")) as f:
+        doc = f.read()
+    documented = set(re.findall(r"`(karpenter_[a-zA-Z0-9_:]+)`", doc))
+    missing = registered - documented
+    assert not missing, (
+        f"metrics registered but absent from docs/observability.md: "
+        f"{sorted(missing)}"
+    )
+    phantom = documented - registered
+    assert not phantom, (
+        f"docs/observability.md catalogs metrics no source registers: "
+        f"{sorted(phantom)}"
+    )
