@@ -312,6 +312,119 @@ def bench_removal_set_sweep(n_nodes: int) -> dict:
     return bench_set_sweep(n_nodes, 100, 1024)
 
 
+def bench_epoch_delta(n_nodes: int, n_pods: int) -> dict:
+    """The delta-vs-snapshot row (epoch PR acceptance): steady-state wire
+    bytes must track *churn + pending pods*, not cluster size, and a
+    repeat same-epoch solve must upload zero per-class table bytes.
+
+    Wire half (host-only): a cluster of `n_nodes` StateNodeViews with one
+    bound pod each; full-snapshot payload vs the SOLVE_DELTA payload
+    after a one-node churn (the epoch client's own encode/diff path).
+    Upload half: two solves of the same problem through a shared
+    epochs.DeviceTableCache — the repeat's table upload bytes are read
+    off the solve trace and must be exactly zero."""
+    import json as _json
+
+    from karpenter_tpu.api import labels as well_known
+    from karpenter_tpu.api.objects import Node, ObjectMeta
+    from karpenter_tpu.solver import epochs
+    from karpenter_tpu.solver.nodes import StateNodeView
+    from karpenter_tpu.solver.service import encode_problem_dict
+    from karpenter_tpu.solver.topology import ClusterSource
+    from karpenter_tpu.testing import fixtures
+
+    def view(i: int) -> StateNodeView:
+        name = f"node-{i:05d}"
+        return StateNodeView(
+            name=name,
+            node_labels={well_known.HOSTNAME_LABEL_KEY: name},
+            labels={
+                well_known.HOSTNAME_LABEL_KEY: name,
+                well_known.INSTANCE_TYPE_LABEL_KEY: "c-2x-amd64-linux",
+                well_known.TOPOLOGY_ZONE_LABEL_KEY: f"zone-{i % 3}",
+                well_known.NODEPOOL_LABEL_KEY: "default",
+            },
+            available={"cpu": 1500, "memory": 3 * 1024**3 * 1000},
+            capacity={"cpu": 2000, "memory": 4 * 1024**3 * 1000},
+            initialized=True,
+        )
+
+    fixtures.reset_rng(17)
+    its = build_universe(144)
+    pools = [fixtures.node_pool(name="default")]
+    ibp = {"default": its}
+    pending = fixtures.make_diverse_pods(n_pods)
+
+    def bound_pod(v):
+        p = fixtures.pod(name=f"b-{v.name}", requests={"cpu": "100m"})
+        p.node_name = v.name
+        return p
+
+    def cluster_of(views, bound):
+        # bound pods keep their identity across reconciles (a real
+        # control plane re-reads the same objects) — regenerating them
+        # would fake churn the delta then has to ship
+        nodes = {
+            v.name: Node(metadata=ObjectMeta(name=v.name, labels=dict(v.labels)))
+            for v in views
+        }
+        return ClusterSource(
+            pods_by_namespace={"default": list(bound)},
+            nodes_by_name=nodes,
+            namespace_labels={"default": {}},
+        )
+
+    views = [view(i) for i in range(n_nodes)]
+    bound = [bound_pod(v) for v in views]
+    req0 = encode_problem_dict(
+        pools, ibp, pending, views, None, None, True, None,
+        cluster_of(views, bound),
+    )
+    snapshot_bytes = len(_json.dumps(req0).encode())
+    base = epochs.sections_from_request(req0)
+    # churn: one node joins (plus its bound pod) — the steady-state shape
+    views2 = views + [view(n_nodes)]
+    bound2 = bound + [bound_pod(views2[-1])]
+    req1 = encode_problem_dict(
+        pools, ibp, pending, views2, None, None, True, None,
+        cluster_of(views2, bound2),
+    )
+    delta = epochs.diff_sections(base, epochs.sections_from_request(req1))
+    delta_frame = {
+        "client": "bench", "base_epoch": 1, "epoch": 2, "delta": delta,
+        "pods_flat": req1["pods_flat"], "options": req1["options"],
+        "force_oracle": True,
+    }
+    delta_bytes = len(_json.dumps(delta_frame).encode())
+
+    from karpenter_tpu.solver.tpu import TpuScheduler
+
+    cache = epochs.DeviceTableCache()
+
+    def upload_solve():
+        pools_u, ibp_u, pods_u, topo_u = make_problem(n_pods, its)
+        sched = TpuScheduler(pools_u, ibp_u, topo_u, table_cache=cache)
+        sched.solve(pods_u)
+        return sched.last_profile.counts.get("upload_bytes", 0)
+
+    first_upload = upload_solve()
+    repeat_upload = upload_solve()
+    row = {
+        "nodes": n_nodes,
+        "pending_pods": n_pods,
+        "snapshot_wire_bytes": snapshot_bytes,
+        "delta_wire_bytes": delta_bytes,
+        "wire_ratio": round(snapshot_bytes / max(1, delta_bytes), 1),
+        "first_upload_bytes": first_upload,
+        "repeat_upload_bytes": repeat_upload,
+    }
+    log(
+        f"  epoch: snapshot {snapshot_bytes} B vs delta {delta_bytes} B "
+        f"({row['wire_ratio']}x); uploads {first_upload} -> {repeat_upload} B"
+    )
+    return row
+
+
 def merge_detail(rows: dict) -> None:
     """Merge bench rows into BENCH_DETAIL.json without clobbering the
     other configs (the --consolidation section updates its row next to
@@ -355,9 +468,26 @@ def main() -> None:
             "BENCH_DETAIL.json)"
         ),
     )
+    ap.add_argument(
+        "--epoch",
+        action="store_true",
+        help=(
+            "epoch delta-vs-snapshot section only: steady-state wire "
+            "bytes + repeat same-epoch upload bytes (writes c10 into "
+            "BENCH_DETAIL.json)"
+        ),
+    )
     args = ap.parse_args()
 
     detail: dict[str, dict] = {}
+
+    if args.epoch:
+        n_nodes, n_pods = (200, 48) if args.quick else (2000, 200)
+        log(f"== epoch: delta vs snapshot wire+upload bytes ({n_nodes} nodes) ==")
+        row = bench_epoch_delta(n_nodes, n_pods)
+        merge_detail({"c10_epoch_delta_wire": row})
+        print(json.dumps(row, indent=2))
+        return
 
     if args.cold:
         # --quick mirrors tests/test_compilecache.py's shape (48 diverse

@@ -62,6 +62,14 @@ METRIC_POLICY: dict[str, str] = {
     "set_eval_dispatches": "exact",
     "set_second_eval_traces": "exact",
     "set_second_eval_compiles": "exact",
+    # epoch steady-state accounting (analysis/ir.py epoch_runtime_
+    # metrics): a repeat same-epoch solve through the device-table cache
+    # (solver/epochs.py) uploads ONLY the pending-pod batch — the
+    # per-class table re-upload counts are absolute-zero contracts
+    "epoch_first_table_uploads": "exact",
+    "epoch_repeat_table_uploads": "exact",
+    "epoch_repeat_pod_table_uploads": "exact",
+    "epoch_repeat_pod_batch_uploads": "ceiling",
 }
 
 

@@ -358,6 +358,15 @@ class _LockBase:
             )
         return fn()
 
+    def _at_fork_reinit(self) -> None:
+        # stdlib modules register lock._at_fork_reinit with os.register_
+        # at_fork at IMPORT time (concurrent.futures.thread does, via its
+        # global shutdown lock) — a module first imported inside an
+        # instrumented test must get the real reinit hook, not an
+        # AttributeError (found by the epoch chaos soak, whose Operator
+        # import pulled in ThreadPoolExecutor under instrumentation)
+        self._raw._at_fork_reinit()
+
     def __repr__(self) -> str:
         return f"<racert {self._racert_kind} from {self._racert_site}>"
 

@@ -48,6 +48,7 @@ from karpenter_tpu import logging as klog
 from karpenter_tpu import metrics
 from karpenter_tpu.api.objects import NodePool, Pod
 from karpenter_tpu.cloudprovider.types import InstanceTypes
+from karpenter_tpu.solver.epochs import SolverOverloaded
 from karpenter_tpu.solver.nodes import StateNodeView
 from karpenter_tpu.solver.oracle import Results, Scheduler, SchedulerOptions
 from karpenter_tpu.solver.topology import ClusterSource, Topology
@@ -100,6 +101,7 @@ class HybridScheduler:
         daemonset_pods: Optional[list[Pod]] = None,
         options: Optional[SchedulerOptions] = None,
         force_oracle: bool = False,
+        table_cache=None,
     ):
         self.force_oracle = force_oracle
         self.used_tpu: Optional[bool] = None
@@ -129,6 +131,9 @@ class HybridScheduler:
                 state_nodes,
                 daemonset_pods,
                 options,
+                # epochs.DeviceTableCache (optional): a repeat solve of an
+                # identical table encoding skips the per-class uploads
+                table_cache=table_cache,
             )
             self.oracle = self.tpu.oracle
         self.opts = self.oracle.opts
@@ -293,6 +298,7 @@ def solve_in_process(
     cluster: Optional[ClusterSource] = None,
     force_oracle: bool = False,
     trace=None,
+    table_cache=None,
 ) -> tuple[Results, HybridScheduler]:
     """THE in-process solve assembly: Topology + HybridScheduler, options
     threaded consistently. Every path that solves locally — the
@@ -300,7 +306,9 @@ def solve_in_process(
     fallback — goes through here, so the three can never diverge on how
     ignore_preferences / cluster state / views reach the scheduler.
     `trace` (tracing.Trace) joins the caller's solve trace; a standalone
-    call owns a local one."""
+    call owns a local one. `table_cache` (epochs.DeviceTableCache,
+    optional — the sidecar server passes its own) lets repeat solves of
+    an unchanged table encoding skip the per-class device uploads."""
     from karpenter_tpu import tracing
 
     with tracing.maybe_trace(trace, "solve") as tr:
@@ -321,6 +329,7 @@ def solve_in_process(
             daemonset_pods,
             options,
             force_oracle=force_oracle,
+            table_cache=table_cache,
         )
         return scheduler.solve(pods, trace=tr), scheduler
 
@@ -481,9 +490,15 @@ class ResilientSolver:
             client = SolverClient(socket_path, request_timeout=request_timeout_seconds)
         self.client = client
         self.request_timeout_seconds = request_timeout_seconds
+        self._clock = clock or time.monotonic
         self.breaker = breaker or CircuitBreaker(
             failure_threshold, cooldown_seconds, clock=clock
         )
+        # admission backpressure (service RETRY frames): the sidecar is
+        # healthy but shedding, so the hint gates re-dialing WITHOUT
+        # feeding the breaker — an overloaded server must not be scored
+        # like a dead one (docs/resilience.md)
+        self._admission_retry_at = 0.0
         self.last_used: Optional[str] = None
         self.fallback_reason: Optional[str] = None
         self.log = klog.root.named("solver.resilient")
@@ -540,7 +555,12 @@ class ResilientSolver:
             wire_timeout = max(
                 wire_timeout, options.timeout_seconds + SOLVE_DEADLINE_GRACE_SECONDS
             )
-        if self.breaker.allow():
+        # backoff is checked BEFORE breaker.allow(): allow() claims the
+        # half-open probe slot as a side effect, and a caller that then
+        # skips the sidecar for admission backoff would strand the probe
+        # until the lost-probe cooldown recovers it
+        in_backoff = self._clock() < self._admission_retry_at
+        if not in_backoff and self.breaker.allow():
             try:
                 with tr.span("sidecar", pods=len(pods)):
                     decoded = self.client.solve(
@@ -566,6 +586,36 @@ class ResilientSolver:
                 self.fallback_reason = None
                 tr.annotate(solver="sidecar")
                 return self._to_results(decoded, pods)
+            except SolverOverloaded as e:
+                # backpressure, NOT a fault: the server answered a RETRY
+                # frame because its solve budget is oversubscribed. The
+                # transport round-tripped, so this VERDICT must reach the
+                # breaker as a success — a half-open probe that lands on
+                # RETRY would otherwise be stranded (neither record_*
+                # called), wedging every caller in-process for an extra
+                # cooldown per lost-probe recovery. Pacing is the
+                # admission backoff's job, not the breaker's.
+                self.breaker.record_success()
+                self._admission_retry_at = self._clock() + max(
+                    0.0, e.backoff_hint_seconds
+                )
+                SIDECAR_REQUESTS.inc({"outcome": "rejected"})
+                SOLVER_FALLBACK.inc({"reason": "admission_rejected"})
+                self.fallback_reason = (
+                    f"sidecar admission rejected (queue depth "
+                    f"{e.queue_depth}); solving in-process, next dial in "
+                    f"{e.backoff_hint_seconds:.3f}s"
+                )
+                tr.event(
+                    "admission_rejected",
+                    queue_depth=e.queue_depth,
+                    backoff_seconds=e.backoff_hint_seconds,
+                )
+                self.log.warn(
+                    "sidecar admission rejected; solving in-process",
+                    queue_depth=e.queue_depth,
+                    backoff_seconds=e.backoff_hint_seconds,
+                )
             except Exception as e:
                 self.breaker.record_failure()
                 SIDECAR_REQUESTS.inc({"outcome": "failure"})
@@ -585,6 +635,12 @@ class ResilientSolver:
                     consecutive_failures=self.breaker.consecutive_failures,
                     breaker=self.breaker.state,
                 )
+        elif in_backoff:
+            SOLVER_FALLBACK.inc({"reason": "admission_rejected"})
+            self.fallback_reason = (
+                "sidecar admission backoff in effect; solving in-process"
+            )
+            tr.event("admission_backoff")
         else:
             SOLVER_FALLBACK.inc({"reason": "circuit_open"})
             self.fallback_reason = (

@@ -11,12 +11,37 @@ speaks it too):
                                   per-CLASS specs + flat base64 columns,
                                   SURVEY §7 hard-part #5 — the per-pod
                                   payload is O(classes) JSON + O(pods)
-                                  binary, not O(pods) JSON)
+                                  binary, not O(pods) JSON; an optional
+                                  "epoch" {client, id} key asks the
+                                  server to retain the cluster sections
+                                  as an epoch — absent it, the frame is
+                                  byte-for-byte the stateless protocol)
                2 RESULT response (payload = JSON header + flat base64
                                   assignment arrays: pod i -> claim index /
                                   existing-node index)
                3 ERROR response  (payload = utf-8 message)
-               4 PING / 5 PONG   (health)
+               4 PING / 5 PONG   (health; an empty-payload PING keeps
+                                  the legacy bare-token PONG — "ready"/
+                                  "prewarming", plus "draining" during
+                                  stop() — while PING payload "v2"
+                                  answers JSON {status,
+                                  admission_queue_depth, epoch_clients,
+                                  epochs})
+               6 SOLVE_DELTA     (payload = JSON {client, base_epoch,
+                                  epoch, delta, pods_flat, options,
+                                  force_oracle}: cluster CHANGES against
+                                  a server-held epoch + the pending-pod
+                                  batch — steady-state wire cost is
+                                  O(churn + pending pods), not O(cluster))
+               7 EPOCH_RESYNC    (retriable response: the base epoch is
+                                  unknown/evicted or the delta failed to
+                                  decode/apply; the client falls back to
+                                  the full-snapshot SOLVE, which is
+                                  always correct from scratch)
+               8 RETRY           (admission rejected: payload JSON
+                                  {retry_after_seconds, queue_depth};
+                                  the caller degrades in-process and
+                                  honors the hint before re-dialing)
     u32     := little-endian
     req_id  := request/response correlation: a response echoes the request's
                id. Responses are in-order per connection (the server is
@@ -41,15 +66,27 @@ responding (hung solve, dead process, black-holed proxy) can never block
 a control-plane call past its deadline (docs/resilience.md).
 
 Fault envelope (tests/test_service_faults.py drives every branch):
-- frames above MAX_FRAME_LEN are refused with an ERROR frame, then the
-  connection closes (the stream past a refused header is untrusted);
+- frames above MAX_FRAME_LEN are refused with an ERROR frame; up to
+  OVERSIZE_DRAIN_MAX the body is drained (discarded under the stall
+  deadline, never buffered) so the stream stays in sync and the
+  connection KEEPS SERVING — an oversized delta costs one refusal, not
+  the stream; beyond the drain cap the length field is corruption and
+  the connection closes;
 - malformed payloads (bad JSON, bad schema) answer ERROR and keep serving;
 - a bad magic closes only that connection — framing is lost, the stream
   cannot be resynchronized;
+- epoch faults (unknown/evicted base epoch, malformed or inapplicable
+  delta, a materialized request that no longer decodes) answer a
+  retriable EPOCH_RESYNC — the client's full-snapshot fallback is
+  always correct from scratch, so no epoch fault can corrupt state;
+- admission rejections answer RETRY with a backoff hint — the server
+  never queues past its solve budget (solver/epochs.py AdmissionGate);
 - the accept loop survives ANY exception escaping a connection handler
   (logged through karpenter_tpu.logging, never fatal);
 - stop() drains: in-flight solves finish and flush their responses before
-  the listener is torn down.
+  the listener is torn down, and NEW solve frames arriving on surviving
+  connections during the drain window get an immediate retriable
+  "draining" ERROR instead of a silent close.
 """
 
 from __future__ import annotations
@@ -69,6 +106,7 @@ import numpy as np
 from karpenter_tpu import logging as klog
 from karpenter_tpu import tracing
 from karpenter_tpu.api import codec
+from karpenter_tpu.solver import epochs
 from karpenter_tpu.solver.hybrid import solve_in_process
 from karpenter_tpu.solver.nodes import StateNodeView
 from karpenter_tpu.solver.oracle import SchedulerOptions
@@ -81,6 +119,9 @@ KIND_RESULT = 2
 KIND_ERROR = 3
 KIND_PING = 4
 KIND_PONG = 5
+KIND_SOLVE_DELTA = 6
+KIND_EPOCH_RESYNC = 7
+KIND_RETRY = 8
 
 # Refuse frames above this size with an ERROR frame: a corrupted length
 # field must not make either side try to buffer gigabytes. 64 MiB clears
@@ -91,6 +132,15 @@ MAX_FRAME_LEN = 64 * 1024 * 1024
 # A peer that starts a frame must finish it within this window; stalling
 # mid-frame is a fault (truncating proxy, wedged client), not idleness.
 FRAME_STALL_SECONDS = 30.0
+
+# A frame above MAX_FRAME_LEN but at or below this cap is DRAINED (read
+# and discarded under the frame-stall wall clock) so the stream stays in
+# sync and the connection keeps serving after the ERROR answer — an
+# oversized delta must not cost the client its connection. Beyond the
+# cap (a corrupted length field, not a real payload) the connection
+# closes as before: draining gigabytes on a liar's say-so is itself a
+# denial of service.
+OVERSIZE_DRAIN_MAX = 4 * MAX_FRAME_LEN
 
 
 class SolverUnavailable(ConnectionError):
@@ -104,6 +154,23 @@ class SolverError(RuntimeError):
     """The sidecar answered a clean ERROR frame: the solve itself failed
     server-side. Transport is healthy; retrying the same problem would
     fail the same way."""
+
+
+# the admission-rejection exception lives in epochs.py (hybrid.py catches
+# it and cannot import this module — service imports hybrid); re-exported
+# here as part of the client's public error surface
+SolverOverloaded = epochs.SolverOverloaded
+
+
+class _OversizedFrame(Exception):
+    """Internal: an oversized frame was fully drained — the stream is
+    still in sync, so the handler answers ERROR and keeps the connection
+    (unlike ProtocolError, which closes it)."""
+
+    def __init__(self, req_id: int, length: int):
+        super().__init__(f"frame of {length} bytes exceeds max {MAX_FRAME_LEN}")
+        self.req_id = req_id
+        self.length = length
 
 
 class ProtocolError(ValueError):
@@ -141,6 +208,22 @@ def _recv_exact_deadline(sock: socket.socket, n: int, deadline: float) -> bytes:
             raise ConnectionError("peer closed")
         buf += got
     return buf
+
+
+def _discard_exact_deadline(sock: socket.socket, n: int, deadline: float) -> None:
+    """Read and throw away n bytes under the same wall-clock discipline as
+    _recv_exact_deadline, in bounded chunks — draining an oversized frame
+    must never buffer it."""
+    left = n
+    while left > 0:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise socket.timeout("deadline exceeded draining oversized frame")
+        sock.settimeout(remaining)
+        got = sock.recv(min(left, 1 << 20))
+        if not got:
+            raise ConnectionError("peer closed")
+        left -= len(got)
 
 
 def _recv_frame_deadline(sock: socket.socket, deadline: float) -> tuple[int, int, bytes]:
@@ -219,7 +302,11 @@ def _encode_views(views) -> list[dict]:
         out.append(
             {
                 "name": v.name,
-                "node_labels": v.node_labels,
+                # copied, not aliased: the epoch client RETAINS these
+                # dicts as its acked sections — an alias would make an
+                # in-place caller mutation compare equal to itself in
+                # diff_sections and silently desync client and server
+                "node_labels": dict(v.node_labels),
                 "labels": dict(v.labels),
                 "taints": codec.to_jsonable(list(v.taints)),
                 "available": dict(v.available),
@@ -313,7 +400,7 @@ def _decode_cluster(req: dict) -> ClusterSource:
     )
 
 
-def encode_problem_request(
+def encode_problem_dict(
     node_pools,
     instance_types_by_pool,
     pods,
@@ -323,11 +410,18 @@ def encode_problem_request(
     force_oracle: bool = False,
     namespace_labels: Optional[dict] = None,
     cluster=None,
-) -> bytes:
+) -> dict:
+    """The full-snapshot request dict — json.dumps of this IS the legacy
+    SOLVE payload (encode_problem_request), and epochs.sections_from_
+    request decomposes it into the epoch sections, so the snapshot, the
+    epoch-establishing snapshot, and the delta-materialized request all
+    share ONE canonical schema."""
     if namespace_labels is None and cluster is not None:
         namespace_labels = cluster.namespace_labels
     req = {
-        "namespace_labels": namespace_labels or {},
+        # copied for the same retained-sections reason as _encode_views:
+        # the caller may mutate its namespace-labels map between solves
+        "namespace_labels": dict(namespace_labels or {}),
         "cluster": _encode_cluster(cluster),
         "node_pools": codec.to_jsonable(node_pools),
         "instance_types_by_pool": {
@@ -356,11 +450,43 @@ def encode_problem_request(
         },
         "force_oracle": force_oracle,
     }
-    return json.dumps(req).encode()
+    return req
+
+
+def encode_problem_request(
+    node_pools,
+    instance_types_by_pool,
+    pods,
+    state_node_views=None,
+    daemonset_pods=None,
+    options: Optional[SchedulerOptions] = None,
+    force_oracle: bool = False,
+    namespace_labels: Optional[dict] = None,
+    cluster=None,
+) -> bytes:
+    return json.dumps(
+        encode_problem_dict(
+            node_pools,
+            instance_types_by_pool,
+            pods,
+            state_node_views,
+            daemonset_pods,
+            options,
+            force_oracle,
+            namespace_labels,
+            cluster,
+        )
+    ).encode()
 
 
 def _decode_problem_request(payload: bytes):
-    req = json.loads(payload)
+    return _decode_problem_dict(json.loads(payload))
+
+
+def _decode_problem_dict(req: dict):
+    """THE request decoder: wire snapshots and delta-materialized epoch
+    requests (epochs.materialize_request) both decode here, so a delta
+    solve can never diverge from its full-resync twin by construction."""
     node_pools = codec.from_jsonable(req["node_pools"])
     its_by_pool = {
         k: codec.from_jsonable(v) for k, v in req["instance_types_by_pool"].items()
@@ -507,6 +633,9 @@ class SolverServer:
         drain_seconds: float = 30.0,
         prewarm: bool = False,
         prewarm_fn=None,
+        admission: Optional[epochs.AdmissionGate] = None,
+        epoch_store: Optional[epochs.EpochStore] = None,
+        table_cache: Optional[epochs.DeviceTableCache] = None,
     ):
         self.socket_path = socket_path
         self.drain_seconds = drain_seconds
@@ -526,6 +655,17 @@ class SolverServer:
         self._stats_lock = threading.Lock()
         self.solves = 0
         self.oracle_degraded_solves = 0
+        # the stateful-with-epochs layer (solver/epochs.py): bounded
+        # per-client epoch store, content-addressed device-table cache,
+        # and the admission gate in front of every solve
+        self.epochs = epoch_store or epochs.EpochStore()
+        self.admission = admission or epochs.AdmissionGate()
+        self.table_cache = table_cache or epochs.DeviceTableCache()
+        # epoch-store writes from handler threads are generation-guarded
+        # (under the stats lock, the prewarm-gen discipline): a handler
+        # abandoned by stop() must not install sections into a LATER
+        # start()'s serving life
+        self._epoch_gen = 0
         self.log = klog.root.named("solver.service")
 
     def start(self) -> None:
@@ -544,6 +684,7 @@ class SolverServer:
         # in _run_prewarm's finally can never see a torn increment.
         with self._stats_lock:
             self._prewarm_gen += 1
+            self._epoch_gen += 1
         if self.prewarm:
             self.ready.clear()
         else:
@@ -675,10 +816,29 @@ class SolverServer:
         finish it (same _recv_exact_deadline discipline as the client — a
         peer trickling one byte per poll interval must not hold the
         handler thread forever); a mid-frame stall is a fault, not
-        idleness."""
+        idleness.
+
+        During drain (stop() set) the poll becomes ONE short grace read:
+        a frame already in flight is still read — _handle answers it with
+        an immediate retriable "draining" ERROR instead of the silent
+        close that used to leave the client waiting out its full deadline
+        (docs/resilience.md drain contract) — but an idle connection
+        closes at once.
+
+        Oversized frames: above MAX_FRAME_LEN but within
+        OVERSIZE_DRAIN_MAX the body is drained (discarded, never
+        buffered) under the same wall-clock deadline and _OversizedFrame
+        is raised — the stream is in sync, so _handle answers ERROR and
+        the connection KEEPS SERVING. Beyond the drain cap the length
+        field is treated as corruption and the connection closes."""
         while True:
             if self._stop.is_set():
-                raise ConnectionError("server stopping")
+                conn.settimeout(0.05)
+                try:
+                    first = conn.recv(1)
+                except socket.timeout:
+                    raise ConnectionError("server stopping")
+                break
             conn.settimeout(0.2)
             try:
                 first = conn.recv(1)
@@ -693,9 +853,13 @@ class SolverServer:
             raise ProtocolError(f"bad magic {head[:4]!r}")
         kind, req_id, length = struct.unpack("<III", head[4:])
         if length > MAX_FRAME_LEN:
-            raise ProtocolError(
-                f"frame of {length} bytes exceeds max {MAX_FRAME_LEN}", req_id=req_id
-            )
+            if length > OVERSIZE_DRAIN_MAX:
+                raise ProtocolError(
+                    f"frame of {length} bytes exceeds max {MAX_FRAME_LEN}",
+                    req_id=req_id,
+                )
+            _discard_exact_deadline(conn, length, deadline)
+            raise _OversizedFrame(req_id, length)
         return kind, req_id, _recv_exact_deadline(conn, length, deadline)
 
     def _send_response(self, conn: socket.socket, kind: int, payload: bytes, req_id: int) -> None:
@@ -705,30 +869,117 @@ class SolverServer:
         conn.settimeout(FRAME_STALL_SECONDS)
         _send_frame(conn, kind, payload, req_id=req_id)
 
+    def _pong_payload(self, verbose: bool) -> bytes:
+        """Readiness plus backpressure observability. An EMPTY-payload
+        PING (every pre-epoch client and probe) keeps the legacy bare
+        token — "ready"/"prewarming" byte-for-byte as before, plus
+        "draining" during stop(), which an equality-on-b"ready" probe
+        correctly reads as not-ready. A PING carrying payload b"v2"
+        (SolverClient.ping_status) answers the JSON form with the
+        admission queue depth and resident-epoch counts."""
+        if self._stop.is_set():
+            status = "draining"
+        elif self.ready.is_set():
+            status = "ready"
+        else:
+            status = "prewarming"
+        if not verbose:
+            return status.encode()
+        clients, resident = self.epochs.stats()
+        return json.dumps(
+            {
+                "status": status,
+                "admission_queue_depth": self.admission.depth(),
+                "epoch_clients": clients,
+                "epochs": resident,
+            }
+        ).encode()
+
+    def _drain_close_check(self) -> None:
+        """During drain, EVERY answered frame is that connection's last:
+        the one-refusal-then-close bound on the SOLVE branch must also
+        cover PING/oversized/unknown-kind traffic, or a fast-sending
+        peer keeps its handler thread (and socket) alive past stop()'s
+        bounded join — and a later start() would find that abandoned
+        handler still serving outside the new generation."""
+        if self._stop.is_set():
+            raise ConnectionError("server stopping")
+
     def _handle(self, conn: socket.socket) -> None:
-        while not self._stop.is_set():
+        while True:
             try:
                 kind, req_id, payload = self._recv_frame_idle(conn)
             except socket.timeout as e:
                 raise ProtocolError(f"peer stalled mid-frame: {e}") from e
-            if kind == KIND_PING:
-                payload = b"ready" if self.ready.is_set() else b"prewarming"
-                self._send_response(conn, KIND_PONG, payload, req_id)
+            except _OversizedFrame as e:
+                # the body was drained — framing is intact, answer and
+                # keep serving this connection (fault-suite contract:
+                # an oversized delta must not cost the client its stream)
+                self.log.warn(
+                    "oversized frame refused", bytes=e.length, req_id=e.req_id
+                )
+                self._send_response(conn, KIND_ERROR, str(e).encode(), e.req_id)
+                self._drain_close_check()
                 continue
-            if kind != KIND_SOLVE:
+            if kind == KIND_PING:
+                self._send_response(
+                    conn, KIND_PONG, self._pong_payload(bool(payload)), req_id
+                )
+                self._drain_close_check()
+                continue
+            if kind not in (KIND_SOLVE, KIND_SOLVE_DELTA):
                 self._send_response(
                     conn, KIND_ERROR, f"unknown kind {kind}".encode(), req_id
                 )
+                self._drain_close_check()
                 continue
+            if self._stop.is_set():
+                # graceful-drain fix: a SOLVE arriving on a surviving
+                # connection during the drain window gets an immediate
+                # retriable refusal instead of riding out drain_seconds
+                # of silence — the caller degrades to the oracle NOW.
+                # ONE refusal, then the connection closes: a peer that
+                # keeps sending must not hold a handler thread (and its
+                # socket) past the drain window the old stop-flag loop
+                # exit used to bound.
+                self._send_response(
+                    conn,
+                    KIND_ERROR,
+                    b"draining: server stopping; degrade in-process and retry later",
+                    req_id,
+                )
+                raise ConnectionError("server stopping")
+            token, hint, depth = self.admission.try_admit(len(payload))
+            if token is None:
+                # never queue: answer RETRY with the backoff hint so the
+                # caller's deadline budget degrades it to the in-process
+                # ladder instead of cascading (docs/resilience.md)
+                body = json.dumps(
+                    {"retry_after_seconds": hint, "queue_depth": depth}
+                ).encode()
+                self.log.warn(
+                    "admission rejected", queue_depth=depth, hint_seconds=hint
+                )
+                self._send_response(conn, KIND_RETRY, body, req_id)
+                continue
+            t0 = time.monotonic()
             try:
-                result = self._solve(payload, req_id)
+                if kind == KIND_SOLVE_DELTA:
+                    out_kind, out = self._solve_delta(payload, req_id)
+                else:
+                    out_kind, out = KIND_RESULT, self._solve(payload, req_id)
             except Exception as e:  # error frames, never a dead socket
                 self.log.warn("solve failed, answering ERROR", error=str(e))
-                self._send_response(
-                    conn, KIND_ERROR, f"{type(e).__name__}: {e}".encode(), req_id
-                )
-                continue
-            self._send_response(conn, KIND_RESULT, result, req_id)
+                out_kind = KIND_ERROR
+                out = f"{type(e).__name__}: {e}".encode()
+            finally:
+                self.admission.release(token)
+            if out_kind == KIND_RESULT:
+                # completed solves teach the gate what a solve actually
+                # costs here — wire bytes under-state delta solves, whose
+                # frames are O(churn) but whose work is O(cluster + pods)
+                self.admission.observe(time.monotonic() - t0)
+            self._send_response(conn, out_kind, out, req_id)
 
     def _solve(self, payload: bytes, req_id: int = 0) -> bytes:
         # the server-side half of the solve trace: same wire correlation
@@ -747,17 +998,126 @@ class SolverServer:
         return result
 
     def _solve_traced(self, payload: bytes, tr) -> bytes:
+        """Full-snapshot SOLVE: byte-for-byte the stateless protocol.
+        An optional "epoch" {client, id} key additionally retains the
+        request's cluster sections in the epoch store AFTER a successful
+        solve — the client only commits its side on RESULT, so both ends
+        agree on what epoch `id` means."""
         with tr.span("wire_decode_request", bytes=len(payload)):
-            (
-                node_pools,
-                its_by_pool,
-                pods,
-                views,
-                daemons,
-                options,
-                force_oracle,
-                source,
-            ) = _decode_problem_request(payload)
+            req = json.loads(payload)
+            epoch_info = req.pop("epoch", None)
+            decoded = _decode_problem_dict(req)
+        gen0 = self._current_epoch_gen()
+        epochs.EPOCH_SOLVES.inc(
+            {"mode": "full_resync" if epoch_info else "snapshot"}
+        )
+        out = self._solve_decoded(decoded, tr)
+        if isinstance(epoch_info, dict):
+            self._store_epoch(
+                gen0,
+                epoch_info.get("client"),
+                epoch_info.get("id"),
+                epochs.sections_from_request(req),
+            )
+        return out
+
+    def _solve_delta(self, payload: bytes, req_id: int) -> tuple[int, bytes]:
+        """SOLVE_DELTA: apply cluster changes against a server-held epoch
+        and solve the riding pending-pod batch. EVERY failure of the
+        epoch machinery — unknown/evicted base, malformed delta, a
+        materialized request that no longer decodes — answers a
+        retriable EPOCH_RESYNC so the client falls back to the
+        full-snapshot path; only the solve itself may raise (becoming an
+        ERROR frame, exactly like the snapshot path)."""
+        tr = tracing.new_trace("solve", side="server")
+        if req_id:
+            tr.set_wire_id(req_id)
+        try:
+            kind, out = self._solve_delta_traced(payload, tr)
+        except BaseException:
+            tr.finish("error")
+            raise
+        tr.finish("ok" if kind == KIND_RESULT else "resync")
+        return kind, out
+
+    def _resync(self, tr, reason: str, detail: str) -> tuple[int, bytes]:
+        epochs.EPOCH_RESYNCS.inc({"reason": reason})
+        tr.event("epoch_resync", reason=reason, detail=detail)
+        self.log.warn("epoch resync", reason=reason, detail=detail)
+        return KIND_EPOCH_RESYNC, json.dumps(
+            {"reason": reason, "detail": detail}
+        ).encode()
+
+    def _solve_delta_traced(self, payload: bytes, tr) -> tuple[int, bytes]:
+        try:
+            with tr.span("wire_decode_request", bytes=len(payload)):
+                d = json.loads(payload)
+            client = d["client"]
+            base_epoch = d["base_epoch"]
+            new_epoch = d["epoch"]
+            pods_flat = d["pods_flat"]
+        except (ValueError, KeyError, TypeError) as e:
+            return self._resync(tr, "decode_error", f"{type(e).__name__}: {e}")
+        base = self.epochs.get(client, base_epoch)
+        if base is None:
+            return self._resync(
+                tr,
+                "unknown_epoch",
+                f"client {client!r} epoch {base_epoch!r} not resident",
+            )
+        gen0 = self._current_epoch_gen()
+        try:
+            with tr.span("epoch_apply"):
+                sections = epochs.apply_delta(base, d.get("delta") or {})
+        except epochs.DeltaError as e:
+            return self._resync(tr, "apply_error", str(e))
+        try:
+            with tr.span("wire_decode_request"):
+                req = epochs.materialize_request(
+                    sections, pods_flat, d.get("options"),
+                    d.get("force_oracle", False),
+                )
+                decoded = _decode_problem_dict(req)
+        except Exception as e:
+            # a delta that applies but no longer decodes means the store
+            # and the client disagree about the world — resync, never
+            # store the poisoned sections
+            return self._resync(
+                tr, "materialize_error", f"{type(e).__name__}: {e}"
+            )
+        # store BEFORE the solve: on a solve ERROR the client keeps its
+        # base epoch (it commits only on RESULT) and both base and new
+        # stay resident, so either retry shape converges
+        self._store_epoch(gen0, client, new_epoch, sections)
+        epochs.EPOCH_SOLVES.inc({"mode": "delta"})
+        return KIND_RESULT, self._solve_decoded(decoded, tr)
+
+    def _current_epoch_gen(self) -> int:
+        with self._stats_lock:
+            return self._epoch_gen
+
+    def _store_epoch(self, gen0: int, client, epoch_id, sections: dict) -> None:
+        """Generation-guarded store write (the prewarm-gen discipline): a
+        handler thread abandoned by stop() must not install sections into
+        a later start()'s serving life."""
+        if client is None or epoch_id is None:
+            return
+        with self._stats_lock:
+            current = gen0 == self._epoch_gen
+        if current:
+            self.epochs.put(str(client), epoch_id, sections)
+
+    def _solve_decoded(self, decoded: tuple, tr) -> bytes:
+        (
+            node_pools,
+            its_by_pool,
+            pods,
+            views,
+            daemons,
+            options,
+            force_oracle,
+            source,
+        ) = decoded
         # mid-prewarm requests degrade to the (decision-identical) oracle:
         # the device path may still be compiling, and a solve must never
         # pay the compile wall nor race the prewarm for the jit caches
@@ -778,6 +1138,7 @@ class SolverServer:
             cluster=source,
             force_oracle=force_oracle,
             trace=tr,
+            table_cache=self.table_cache,
         )
         with self._stats_lock:
             self.solves += 1
@@ -807,11 +1168,24 @@ class SolverClient:
     - transport failures (refused/reset/closed) reconnect with exponential
       backoff + jitter up to `max_retries`, inside the same deadline. A
       SOLVE is stateless server-side, so retrying a possibly-executed
-      request is safe.
+      request is safe. A SOLVE_DELTA retry is idempotent too: re-applying
+      base->new overwrites the new epoch with identical sections while
+      the base stays resident.
 
     Exhausting the budget raises SolverUnavailable; a clean server-side
-    ERROR frame raises SolverError. Callers (ResilientSolver) treat both
-    as 'degrade down the ladder'."""
+    ERROR frame raises SolverError; an admission RETRY frame raises
+    SolverOverloaded. Callers (ResilientSolver) treat all three as
+    'degrade down the ladder' (overload additionally carries a backoff
+    hint and skips the breaker).
+
+    Epoch mode (`epochs=True`, the default): the client keeps the last
+    server-ACKNOWLEDGED cluster sections and ships only the diff
+    (SOLVE_DELTA) against that epoch; any EPOCH_RESYNC answer — evicted
+    epoch, restarted server, failed delta — drops the local epoch state
+    and falls back to the full-snapshot request IN THE SAME CALL (one
+    hop, structurally loop-free: a full snapshot is never answered with
+    RESYNC). With `epochs=False` every request is the byte-for-byte
+    legacy snapshot."""
 
     def __init__(
         self,
@@ -823,6 +1197,7 @@ class SolverClient:
         backoff_cap: float = 2.0,
         rng: Optional[random.Random] = None,
         sleep=time.sleep,
+        epochs: bool = True,
     ):
         self.socket_path = socket_path
         self.connect_timeout = connect_timeout
@@ -846,6 +1221,20 @@ class SolverClient:
         # correlation id of the most recent frame sent: solve() stamps it
         # onto the caller's trace so client and sidecar spans join
         self.last_req_id = 0
+        # -- epoch state (solver/epochs.py) -------------------------------
+        # the client id keys the server's epoch store across reconnects;
+        # random so a restarted control plane never aliases its
+        # predecessor's epochs (a stale alias would DELTA against someone
+        # else's world — the resync path would catch a missing epoch, but
+        # an id collision with a matching epoch number would not)
+        self.epochs_enabled = epochs
+        self.client_id = f"c{self._rng.randrange(0, 16**12):012x}"
+        self._epoch_seq = 0
+        self._acked_epoch: Optional[int] = None
+        self._acked_sections: Optional[dict] = None
+        self.resyncs = 0
+        self.delta_solves = 0
+        self.full_solves = 0
 
     # -- connection management --------------------------------------------
 
@@ -947,6 +1336,48 @@ class SolverClient:
         kind, _ = self._roundtrip(KIND_PING, b"", timeout)
         return kind == KIND_PONG
 
+    def ping_status(self, timeout: Optional[float] = None) -> dict:
+        """The verbose PONG: {status, admission_queue_depth,
+        epoch_clients, epochs}. Empty-payload pings keep the legacy bare
+        token for old probes; this opts into the JSON form. A PRE-epoch
+        server ignores the v2 payload and answers the bare token — that
+        degrades to a status-only dict here, never an exception against
+        a healthy old sidecar."""
+        kind, resp = self._roundtrip(KIND_PING, b"v2", timeout)
+        if kind != KIND_PONG:
+            raise SolverError(f"PING answered kind {kind}")
+        try:
+            return json.loads(resp)
+        except ValueError:
+            return {"status": resp.decode(errors="replace")}
+
+    @staticmethod
+    def _overloaded(resp: bytes) -> SolverOverloaded:
+        try:
+            d = json.loads(resp)
+            hint = float(d.get("retry_after_seconds", 0.0))
+            depth = int(d.get("queue_depth", 0))
+        except (ValueError, TypeError):
+            hint, depth = 0.0, 0
+        return SolverOverloaded(
+            f"sidecar admission rejected (queue depth {depth}); "
+            f"retry after {hint:.3f}s",
+            backoff_hint_seconds=hint,
+            queue_depth=depth,
+        )
+
+    def _finish_result(self, kind: int, resp: bytes, pods, trace) -> dict:
+        if trace is not None:
+            # the correlation id of the attempt that ANSWERED (retries
+            # re-id; last_req_id tracks the final frame on the wire)
+            trace.set_wire_id(self.last_req_id)
+        if kind == KIND_RETRY:
+            raise self._overloaded(resp)
+        if kind == KIND_ERROR:
+            raise SolverError(resp.decode())
+        with tracing.span_of(trace, "wire_decode", bytes=len(resp)):
+            return decode_result(json.loads(resp), pods)
+
     def solve(
         self,
         node_pools,
@@ -963,9 +1394,16 @@ class SolverClient:
     ) -> dict:
         """`trace` (tracing.Trace, optional): wire-phase spans land on it
         and the SOLVE frame's correlation id becomes the trace id, joining
-        this client-side trace with the sidecar's server-side one."""
+        this client-side trace with the sidecar's server-side one.
+
+        Epoch mode ships a SOLVE_DELTA when a server-acknowledged epoch
+        exists, falling back to the full snapshot on EPOCH_RESYNC — one
+        extra hop inside the same deadline, never a loop. The local epoch
+        state commits only on a RESULT frame, mirroring the server (which
+        stores sections before answering), so a lost response leaves both
+        resident epochs intact and either retry shape converges."""
         with tracing.span_of(trace, "wire_encode", pods=len(pods)):
-            payload = encode_problem_request(
+            req = encode_problem_dict(
                 node_pools,
                 instance_types_by_pool,
                 pods,
@@ -976,13 +1414,81 @@ class SolverClient:
                 namespace_labels,
                 cluster,
             )
-        with tracing.span_of(trace, "wire_roundtrip", bytes=len(payload)):
+            if not self.epochs_enabled:
+                payload = json.dumps(req).encode()
+            else:
+                sections = epochs.sections_from_request(req)
+        if not self.epochs_enabled:
+            with tracing.span_of(trace, "wire_roundtrip", bytes=len(payload)):
+                kind, resp = self._roundtrip(KIND_SOLVE, payload, timeout)
+            return self._finish_result(kind, resp, pods, trace)
+
+        if self._acked_epoch is not None:
+            delta = epochs.diff_sections(self._acked_sections, sections)
+            self._epoch_seq += 1
+            body = {
+                "client": self.client_id,
+                "base_epoch": self._acked_epoch,
+                "epoch": self._epoch_seq,
+                "delta": delta,
+                "pods_flat": req["pods_flat"],
+                "options": req["options"],
+                "force_oracle": req["force_oracle"],
+            }
+            payload = json.dumps(body).encode()
+            # an oversized delta (mass churn) would be refused on arrival;
+            # skip straight to the snapshot instead of burning a round trip
+            if HEADER_LEN + len(payload) <= MAX_FRAME_LEN:
+                with tracing.span_of(
+                    trace, "wire_roundtrip", bytes=len(payload), mode="delta"
+                ):
+                    kind, resp = self._roundtrip(KIND_SOLVE_DELTA, payload, timeout)
+                if kind == KIND_EPOCH_RESYNC:
+                    # retriable by contract: drop local epoch state and
+                    # fall through to the always-correct full snapshot
+                    self.resyncs += 1
+                    self._acked_epoch = None
+                    self._acked_sections = None
+                    if trace is not None:
+                        trace.event("epoch_resync", server=resp.decode())
+                elif kind == KIND_ERROR and resp.startswith(b"unknown kind"):
+                    # a PRE-EPOCH server (mixed-version rollout: control
+                    # plane upgraded first) doesn't speak SOLVE_DELTA;
+                    # its snapshot path ignored our epoch key, so the
+                    # acked state is a fiction. Disable epoch mode for
+                    # this client's lifetime and fall through to the
+                    # plain snapshot — without this, every solve would
+                    # retry the delta, fail identically, and feed the
+                    # breaker against a healthy old sidecar.
+                    self.resyncs += 1
+                    self.epochs_enabled = False
+                    self._acked_epoch = None
+                    self._acked_sections = None
+                    if trace is not None:
+                        trace.event("epoch_resync", server="pre-epoch peer")
+                    payload = json.dumps(req).encode()
+                    with tracing.span_of(
+                        trace, "wire_roundtrip", bytes=len(payload), mode="legacy"
+                    ):
+                        kind, resp = self._roundtrip(KIND_SOLVE, payload, timeout)
+                    return self._finish_result(kind, resp, pods, trace)
+                else:
+                    out = self._finish_result(kind, resp, pods, trace)
+                    self._acked_epoch = body["epoch"]
+                    self._acked_sections = sections
+                    self.delta_solves += 1
+                    return out
+
+        # full snapshot, establishing (or re-establishing) an epoch
+        self._epoch_seq += 1
+        req["epoch"] = {"client": self.client_id, "id": self._epoch_seq}
+        payload = json.dumps(req).encode()
+        with tracing.span_of(
+            trace, "wire_roundtrip", bytes=len(payload), mode="full"
+        ):
             kind, resp = self._roundtrip(KIND_SOLVE, payload, timeout)
-        if trace is not None:
-            # the correlation id of the attempt that ANSWERED (retries
-            # re-id; last_req_id tracks the final frame on the wire)
-            trace.set_wire_id(self.last_req_id)
-        if kind == KIND_ERROR:
-            raise SolverError(resp.decode())
-        with tracing.span_of(trace, "wire_decode", bytes=len(resp)):
-            return decode_result(json.loads(resp), pods)
+        out = self._finish_result(kind, resp, pods, trace)
+        self._acked_epoch = self._epoch_seq
+        self._acked_sections = sections
+        self.full_solves += 1
+        return out

@@ -41,6 +41,7 @@ from karpenter_tpu.ops.kernels import VocabArrays
 from karpenter_tpu.scheduling import Requirement, Requirements
 from karpenter_tpu.solver import buckets
 from karpenter_tpu.solver import nodes as nodes_mod
+from karpenter_tpu.solver.epochs import problem_fingerprint
 from karpenter_tpu.solver.nodes import (
     SchedulingNodeClaim,
     StateNodeView,
@@ -474,6 +475,7 @@ class TpuScheduler:
         state_nodes: Optional[list[StateNodeView]] = None,
         daemonset_pods: Optional[list[Pod]] = None,
         options: Optional[SchedulerOptions] = None,
+        table_cache=None,
     ):
         # reuse the oracle's init wholesale: template filtering, daemon
         # overhead, existing-node ordering, limits (scheduler.go:116)
@@ -486,6 +488,15 @@ class TpuScheduler:
             options,
         )
         self.opts = self.oracle.opts
+        # epochs.DeviceTableCache (optional, shared across schedulers —
+        # the sidecar server owns one): device table sets keyed by the
+        # content fingerprint of every encoded array they derive from. A
+        # hit skips _tables/_upload_pod_tables entirely, so a repeat
+        # same-epoch solve uploads only the pending-pod batch (the
+        # `epoch[runtime]` ir-transfer budget pins the zero; CLAUDE.md's
+        # _ktpu_* invalidation invariant extends to these copies because
+        # any relax/class-key mutation perturbs the fingerprinted arrays)
+        self._table_cache = table_cache
 
     # -- solve ----------------------------------------------------------
 
@@ -535,11 +546,30 @@ class TpuScheduler:
         from karpenter_tpu.solver import tpu_runs as KR
 
         with prof.span("upload"):
-            tb = self._tables(problem)  # also sets self._typeok
-            self._upload_pod_tables(problem)
-            upload_bytes = _tree_nbytes(tb) + _tree_nbytes(self._dev_tables)
-        prof.count("upload_bytes", by=upload_bytes)
-        tracing.SOLVE_UPLOAD_BYTES.inc(by=upload_bytes)
+            cached = None
+            fp = None
+            if self._table_cache is not None:
+                fp = problem_fingerprint(problem)
+                cached = self._table_cache.get(fp)
+            if cached is not None:
+                # device-resident hit: zero bytes cross the tunnel for
+                # tables — the only remaining per-solve upload is the
+                # pending-pod index batch (_pod_xs_with_idx)
+                tb, self._typeok, self._dev_tables, self._aff_c = cached
+                upload_bytes = 0
+                prof.event("table_cache", outcome="hit")
+            else:
+                tb = self._tables(problem)  # also sets self._typeok
+                self._upload_pod_tables(problem)
+                upload_bytes = _tree_nbytes(tb) + _tree_nbytes(self._dev_tables)
+                if self._table_cache is not None:
+                    self._table_cache.put(
+                        fp, (tb, self._typeok, self._dev_tables, self._aff_c)
+                    )
+                    prof.event("table_cache", outcome="miss")
+        if upload_bytes:
+            prof.count("upload_bytes", by=upload_bytes)
+            tracing.SOLVE_UPLOAD_BYTES.inc(by=upload_bytes)
         gates_ok = _bulk_gates(problem, strict_types=False)
         self._bulk_flags_c = _bulk_class_flags(problem, gates_ok)
         # trace-time static: with no relaxable requirement classes the

@@ -79,14 +79,18 @@ def pytest_runtest_call(item):
 # `faults`-marked test — the whole fault-injection/chaos envelope exercises
 # the service boundary's real thread interleavings — runs with instrumented
 # locks, and fails on any observed lock-order inversion or uncaught
-# background-thread exception. Opt in from any other test with
-# @pytest.mark.racert. Overhead is a raw frame walk per acquire
-# (microseconds), so the tier-1 budget is untouched.
+# background-thread exception. The `soak` marker (the epoch/admission
+# steady-workload chaos soak) rides the same instrumentation: its
+# acceptance criterion is literally "zero racert inversions witnessed".
+# Opt in from any other test with @pytest.mark.racert. Overhead is a raw
+# frame walk per acquire (microseconds), so the tier-1 budget is
+# untouched.
 @pytest.fixture(autouse=True)
 def _racert_witness(request):
     if (
         request.node.get_closest_marker("faults") is None
         and request.node.get_closest_marker("racert") is None
+        and request.node.get_closest_marker("soak") is None
     ):
         yield
         return

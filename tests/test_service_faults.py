@@ -22,6 +22,7 @@ bug that wedges a socket fails fast instead of hanging tier-1.
 
 from __future__ import annotations
 
+import json
 import socket
 import struct
 import tempfile
@@ -41,15 +42,20 @@ from karpenter_tpu.solver import (
 )
 from karpenter_tpu.solver.hybrid import SIDECAR_REQUESTS, SOLVER_FALLBACK
 from karpenter_tpu.solver.service import (
+    KIND_EPOCH_RESYNC,
     KIND_ERROR,
     KIND_PING,
     KIND_PONG,
+    KIND_RESULT,
+    KIND_RETRY,
     KIND_SOLVE,
+    KIND_SOLVE_DELTA,
     MAGIC,
     MAX_FRAME_LEN,
     ProtocolError,
     SolverClient,
     SolverError,
+    SolverOverloaded,
     SolverServer,
     SolverUnavailable,
 )
@@ -339,17 +345,52 @@ def _read_exact(sock, n):
     return buf
 
 
-def test_oversized_frame_refused_with_error(server):
+def test_oversized_frame_drained_and_connection_kept_usable(server, monkeypatch):
+    """Satellite (epoch PR): an oversized frame — the shape a mass-churn
+    delta would take if the client didn't pre-check — is refused with an
+    ERROR after its body is DRAINED, and the SAME connection keeps
+    serving: the stream stayed in sync, so refusing the frame must not
+    cost the client its connection."""
+    from karpenter_tpu.solver import service as svc
+
+    monkeypatch.setattr(svc, "MAX_FRAME_LEN", 1024)
+    monkeypatch.setattr(svc, "OVERSIZE_DRAIN_MAX", 4 * 1024)
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.settimeout(10)
     sock.connect(server.socket_path)
-    sock.sendall(MAGIC + struct.pack("<III", KIND_SOLVE, 3, MAX_FRAME_LEN + 1))
+    body = b"x" * 2048  # over MAX, under the drain cap, body fully sent
+    sock.sendall(MAGIC + struct.pack("<III", KIND_SOLVE, 3, len(body)) + body)
     head = _read_exact(sock, 16)
     kind, rid, length = struct.unpack("<III", head[4:])
     payload = _read_exact(sock, length)
     assert (kind, rid) == (KIND_ERROR, 3)
     assert b"exceeds max" in payload
-    # the stream past a refused header is untrusted: the server closes it
+    # the stream is in sync: the SAME connection serves the next frame
+    sock.sendall(MAGIC + struct.pack("<III", KIND_PING, 4, 0))
+    head = _read_exact(sock, 16)
+    kind, rid, length = struct.unpack("<III", head[4:])
+    _read_exact(sock, length)
+    assert (kind, rid) == (KIND_PONG, 4)
+    sock.close()
+
+
+def test_oversized_frame_beyond_drain_cap_closes(server, monkeypatch):
+    """A length field past OVERSIZE_DRAIN_MAX is corruption, not a real
+    payload: the server answers ERROR and closes (draining gigabytes on
+    a liar's say-so would itself be a denial of service)."""
+    from karpenter_tpu.solver import service as svc
+
+    monkeypatch.setattr(svc, "MAX_FRAME_LEN", 1024)
+    monkeypatch.setattr(svc, "OVERSIZE_DRAIN_MAX", 4 * 1024)
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(10)
+    sock.connect(server.socket_path)
+    sock.sendall(MAGIC + struct.pack("<III", KIND_SOLVE, 3, 1 << 30))
+    head = _read_exact(sock, 16)
+    kind, rid, length = struct.unpack("<III", head[4:])
+    payload = _read_exact(sock, length)
+    assert (kind, rid) == (KIND_ERROR, 3)
+    assert b"exceeds max" in payload
     assert sock.recv(1) == b""
     sock.close()
     # but the listener is untouched
@@ -815,9 +856,12 @@ def test_client_mid_prewarm_degrades_to_oracle_then_recovers():
     srv.start()
     try:
         client = SolverClient(path)
-        # readiness surfaces on the wire while the ladder compiles
+        # readiness surfaces on the wire while the ladder compiles — the
+        # legacy empty-payload PING keeps its bare-token PONG (wire
+        # compat pin), and the v2 form carries the same status
         kind, payload = client._roundtrip(KIND_PING, b"", 10.0)
         assert kind == KIND_PONG and payload == b"prewarming"
+        assert client.ping_status(10.0)["status"] == "prewarming"
         assert not srv.ready.is_set()
 
         pools, ibp, pods = _problem(8)
@@ -925,3 +969,557 @@ def test_kill_mid_prewarm_does_not_poison_cache(tmp_path):
     )
     assert out.returncode == 0, out.stderr[-4000:]
     assert "PREWARM_DONE 4" in out.stdout, out.stdout
+
+
+# ---------------------------------------------------------------------------
+# epoch/resync state machine + admission + drain (epoch PR tentpole)
+
+
+def _in_process_parts(n=8):
+    """The decision-identity referee: the same problem solved entirely
+    in-process on the oracle."""
+    pools, ibp, pods = _problem(n)
+    topo = Topology(pools, ibp, pods)
+    s = HybridScheduler(
+        pools, ibp, topo, None, None, SchedulerOptions(), force_oracle=True
+    )
+    r = s.solve(pods)
+    return sorted(
+        tuple(sorted(p.name for p in cl.pods))
+        for cl in r.new_node_claims
+        if cl.pods
+    )
+
+
+def test_epoch_mismatch_storm_converges_without_resync_loop(server):
+    """A storm of epoch desyncs (the server's store evicted before every
+    delta) must cost exactly ONE resync hop per solve — the full-snapshot
+    fallback re-establishes the epoch in the same call, never loops —
+    and every answer stays decision-identical to in-process."""
+    c = SolverClient(server.socket_path, request_timeout=120.0)
+    pools, ibp, pods = _problem(8)
+    referee = _in_process_parts(8)
+    assert _remote_parts(
+        c.solve(pools, ibp, pods, force_oracle=True), pods
+    ) == referee
+    for round_i in range(4):
+        server.epochs.clear()  # desync: every resident epoch evicted
+        got = c.solve(pools, ibp, pods, force_oracle=True)
+        assert _remote_parts(got, pods) == referee
+    # one establishing snapshot + one resync-driven snapshot per storm
+    # round; NO delta round trips were wasted re-trying
+    assert c.resyncs == 4, c.resyncs
+    assert c.full_solves == 5 and c.delta_solves == 0
+    # with the store stable again, deltas resume
+    got = c.solve(pools, ibp, pods, force_oracle=True)
+    assert _remote_parts(got, pods) == referee
+    assert c.delta_solves == 1 and c.resyncs == 4
+    c.close()
+
+
+def test_malformed_delta_answers_resync_and_keeps_serving(server):
+    """Garbage SOLVE_DELTA payloads (bad JSON, unknown sections, keyed
+    deltas against nothing) answer a retriable EPOCH_RESYNC on the same
+    connection — never an ERROR, never a closed stream."""
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(10)
+    sock.connect(server.socket_path)
+    for i, payload in enumerate(
+        (
+            b"{not json",
+            b'{"client": "x", "base_epoch": 1}',  # missing fields
+            b'{"client": "x", "base_epoch": 9, "epoch": 10, '
+            b'"pods_flat": {}, "delta": {}}',  # unknown epoch
+        )
+    ):
+        sock.sendall(
+            MAGIC + struct.pack("<III", KIND_SOLVE_DELTA, 20 + i, len(payload))
+            + payload
+        )
+        head = _read_exact(sock, 16)
+        kind, rid, length = struct.unpack("<III", head[4:])
+        body = _read_exact(sock, length)
+        assert (kind, rid) == (KIND_EPOCH_RESYNC, 20 + i), body
+    # connection still serves
+    sock.sendall(MAGIC + struct.pack("<III", KIND_PING, 30, 0))
+    head = _read_exact(sock, 16)
+    kind, rid, length = struct.unpack("<III", head[4:])
+    _read_exact(sock, length)
+    assert (kind, rid) == (KIND_PONG, 30)
+    sock.close()
+
+
+def test_mid_delta_kill_of_server_resyncs_decision_identically(server):
+    """Mid-delta SIGKILL analog, server side: the server dies between an
+    established epoch and the next delta. The replacement (fresh process
+    => empty epoch store) answers EPOCH_RESYNC and the SAME call's full
+    resync returns a schedule decision-identical to in-process."""
+    c = SolverClient(server.socket_path, request_timeout=120.0)
+    c.backoff_base = 0.01  # keep reconnect sleeps test-sized
+    pools, ibp, pods = _problem(8)
+    referee = _in_process_parts(8)
+    c.solve(pools, ibp, pods, force_oracle=True)  # epoch established
+    server.stop()  # "kill": the store dies with the process
+    replacement = SolverServer(server.socket_path)
+    replacement.start()
+    try:
+        got = c.solve(pools, ibp, pods, force_oracle=True)
+        assert c.resyncs == 1 and c.full_solves == 2
+        assert _remote_parts(got, pods) == referee
+        # and the very next solve rides a delta against the NEW epoch
+        got = c.solve(pools, ibp, pods, force_oracle=True)
+        assert c.delta_solves == 1
+        assert _remote_parts(got, pods) == referee
+    finally:
+        replacement.stop()
+    c.close()
+
+
+def test_mid_delta_kill_of_client_leaves_full_resync_identical(server):
+    """Mid-delta SIGKILL analog, client side: a client dies after sending
+    HALF a delta frame (the server never sees the rest). A fresh client —
+    no epoch memory, like a restarted control plane — must solve full
+    snapshot, decision-identical to in-process."""
+    c1 = SolverClient(server.socket_path, request_timeout=120.0)
+    pools, ibp, pods = _problem(8)
+    referee = _in_process_parts(8)
+    c1.solve(pools, ibp, pods, force_oracle=True)
+    # half a delta frame, then the "process" dies
+    partial = b'{"client": "' + c1.client_id.encode()
+    c1._sock.sendall(
+        MAGIC + struct.pack("<III", KIND_SOLVE_DELTA, 99, len(partial) + 64)
+        + partial
+    )
+    c1._sock.close()  # SIGKILL analog: mid-frame, no goodbye
+    c1._sock = None
+
+    c2 = SolverClient(server.socket_path, request_timeout=120.0)
+    got = c2.solve(pools, ibp, pods, force_oracle=True)
+    assert c2.full_solves == 1 and c2.resyncs == 0
+    assert _remote_parts(got, pods) == referee
+    c2.close()
+
+
+def test_drain_answers_new_solves_with_immediate_retriable_error(server, monkeypatch):
+    """Graceful-drain satellite: while stop() drains an in-flight solve,
+    a NEW solve on a surviving connection is answered with an immediate
+    'draining' ERROR — the caller degrades to the oracle NOW instead of
+    waiting out its wire deadline in silence."""
+    original = SolverServer._solve
+
+    def slow(self, payload, req_id=0):
+        time.sleep(1.5)
+        return original(self, payload, req_id)
+
+    monkeypatch.setattr(SolverServer, "_solve", slow)
+    pools, ibp, pods = _problem(2)
+    a = SolverClient(server.socket_path, request_timeout=120.0)
+    box = {}
+
+    def solve_a():
+        box["a"] = a.solve(pools, ibp, pods, force_oracle=True)
+
+    t = threading.Thread(target=solve_a, daemon=True)
+    t.start()
+    time.sleep(0.3)  # solve in flight on connection A
+
+    b = SolverClient(server.socket_path, request_timeout=120.0)
+    assert b.ping()  # B's connection established pre-drain
+
+    stopper = threading.Thread(target=server.stop, daemon=True)
+    stopper.start()
+    time.sleep(0.2)  # drain window open, A still solving
+    t0 = time.monotonic()
+    with pytest.raises(SolverError, match="draining"):
+        b.solve(pools, ibp, pods, force_oracle=True)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0, f"draining refusal took {elapsed:.2f}s, not immediate"
+    # the in-flight solve still drained to completion
+    t.join(timeout=30)
+    assert "a" in box and box["a"]["new_node_claims"]
+    stopper.join(timeout=30)
+    a.close()
+    b.close()
+
+
+def test_admission_rejection_degrades_to_oracle_without_breaker_trip(server):
+    """Admission tentpole: with the gate full, the server answers RETRY
+    (not ERROR) and ResilientSolver degrades to the oracle WITHOUT
+    scoring a breaker failure, then honors the backoff hint before
+    re-dialing."""
+    from karpenter_tpu.solver import epochs as epochs_mod
+
+    # a gate with zero inflight slots rejects everything
+    server.admission.max_inflight = 0
+    fake_now = {"t": 1000.0}
+    rs = ResilientSolver(
+        server.socket_path,
+        request_timeout_seconds=30.0,
+        clock=lambda: fake_now["t"],
+    )
+    pools, ibp, pods = _problem(4)
+    rejected_before = epochs_mod.ADMISSION_REJECTED.value()
+    r = rs.solve(pools, ibp, pods, force_oracle=True)
+    assert not r.pod_errors
+    assert rs.last_used == "oracle"
+    assert "admission rejected" in rs.fallback_reason
+    assert rs.breaker.state == "closed", "backpressure must not trip the breaker"
+    assert rs.breaker.consecutive_failures == 0
+    assert epochs_mod.ADMISSION_REJECTED.value() > rejected_before
+    assert rs._admission_retry_at > fake_now["t"]
+
+    # inside the backoff window the sidecar is not even dialed
+    dials = rs.client.reconnects
+    r = rs.solve(pools, ibp, pods, force_oracle=True)
+    assert rs.last_used == "oracle" and rs.client.reconnects == dials
+
+    # capacity restored + hint elapsed -> sidecar serves again
+    server.admission.max_inflight = 4
+    fake_now["t"] += 3600.0
+    r = rs.solve(pools, ibp, pods, force_oracle=True)
+    assert rs.last_used == "sidecar" and not r.pod_errors
+
+
+def test_pong_surfaces_epoch_and_admission_backpressure(server):
+    """Satellite: the v2 PONG carries epoch residency + admission queue
+    depth so probes can observe backpressure — while the legacy
+    empty-payload PING answers the bare token byte-for-byte (old probes
+    comparing `== b"ready"` keep working against an epoch server)."""
+    c = SolverClient(server.socket_path, request_timeout=60.0)
+    pools, ibp, pods = _problem(2)
+    c.solve(pools, ibp, pods, force_oracle=True)
+    kind, payload = c._roundtrip(KIND_PING, b"", 10.0)
+    assert (kind, payload) == (KIND_PONG, b"ready")  # legacy form intact
+    pong = c.ping_status(10.0)
+    assert pong["status"] == "ready"
+    assert pong["epochs"] >= 1 and pong["epoch_clients"] >= 1
+    assert pong["admission_queue_depth"] == 0
+    from karpenter_tpu.solver import epochs as epochs_mod
+
+    assert epochs_mod.EPOCHS_RESIDENT.value() >= 1
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# the steady-workload chaos soak (epoch desync + mid-delta kill +
+# admission rejection + concurrent-client partial failure)
+
+
+@pytest.mark.soak
+def test_chaos_soak_epoch_service_decision_identical():
+    """THE epoch acceptance scenario: a steady provision/consolidate
+    workload rides the sidecar through the fault proxy while the soak
+    injects, in rotation: epoch desync (store cleared), mid-delta kill
+    (response truncated/corrupted mid-frame), admission rejection (gate
+    closed for a tick), and a drain/restart. Every returned schedule must
+    leave the control plane on the SAME trajectory as the in-process
+    oracle referee — same per-tick node counts, same final partition —
+    and the racert witness (armed by the soak marker) must see zero
+    lock-order inversions."""
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.operator import Operator
+    from karpenter_tpu.api.objects import Budget, PodPhase
+
+    def steady_op(solver=None):
+        op = Operator(clock=FakeClock(), force_oracle=True, solver=solver)
+        op.raw_cloud.types = construct_instance_types(sizes=[2, 8, 32])
+        op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+        fixtures.reset_rng(5)
+        op.kube.create(
+            "NodePool",
+            fixtures.node_pool(
+                name="default",
+                budgets=[Budget(nodes="100%")],
+                consolidate_after_seconds=0.0,
+            ),
+        )
+        for i in range(10):
+            op.kube.create(
+                "Pod",
+                fixtures.pod(
+                    name=f"w-{i}", requests={"cpu": "400m", "memory": "256Mi"}
+                ),
+            )
+        op.run_until_settled(max_ticks=60)
+        for p in op.kube.list("Pod"):
+            p.phase = PodPhase.RUNNING
+            op.kube.update("Pod", p)
+        return op
+
+    def run(solver=None, chaos=None):
+        # deterministic per-tick churn (identical in both runs — chaos
+        # touches only the service layer): one pod leaves, one arrives,
+        # so EVERY tick carries a provisioning solve through the sidecar
+        # and the epoch store sees real delta traffic to desync
+        op = steady_op(solver=solver)
+        counts = []
+        next_id = 10
+        for tick in range(30):
+            if chaos is not None:
+                chaos(tick)
+            bound = sorted(
+                (p for p in op.kube.list("Pod") if p.node_name),
+                key=lambda p: p.name,
+            )
+            if bound:
+                op.kube.delete("Pod", bound[0].name)
+            op.kube.create(
+                "Pod",
+                fixtures.pod(
+                    name=f"w-{next_id}",
+                    requests={"cpu": "400m", "memory": "256Mi"},
+                ),
+            )
+            next_id += 1
+            op.step(2.0)
+            for p in op.kube.list("Pod"):
+                if p.node_name and p.phase == PodPhase.PENDING:
+                    p.phase = PodPhase.RUNNING
+                    op.kube.update("Pod", p)
+            counts.append(len(op.kube.list("Node")))
+        by_node: dict[str, set] = {}
+        for p in op.kube.list("Pod"):
+            by_node.setdefault(p.node_name, set()).add(p.name)
+        return counts, sorted(tuple(sorted(s)) for s in by_node.values())
+
+    counts_ref, partition_ref = run()
+
+    sock_path = tempfile.mktemp(suffix=".soak.sock")
+    srv = SolverServer(sock_path)
+    srv.start()
+    proxy_path = tempfile.mktemp(suffix=".soakproxy.sock")
+    proxy = FaultyProxy(proxy_path, sock_path)
+    rs = ResilientSolver(
+        proxy_path, request_timeout_seconds=120.0, failure_threshold=50
+    )
+    rs.client.backoff_base = 0.01
+    state = {"srv": srv}
+
+    def chaos(tick):
+        if tick == 4:
+            state["srv"].epochs.clear()  # epoch desync
+        elif tick == 8:
+            proxy.set_fault("truncate", once=True, truncate_after=12)
+        elif tick == 12:
+            proxy.set_fault("corrupt", once=True)
+        elif tick == 16:
+            state["srv"].admission.max_inflight = 0  # admission storm...
+        elif tick == 17:
+            state["srv"].admission.max_inflight = 4  # ...one tick long
+            rs._admission_retry_at = 0.0  # hint elapsed (wall-clock gate)
+        elif tick == 20:
+            # drain + replace: the replacement has an empty epoch store,
+            # so the next delta resyncs
+            state["srv"].stop()
+            state["srv"] = SolverServer(sock_path)
+            state["srv"].start()
+
+    try:
+        counts_soak, partition_soak = run(solver=rs, chaos=chaos)
+    finally:
+        proxy.stop()
+        state["srv"].stop()
+
+    assert counts_soak == counts_ref, (
+        f"soak diverged from the oracle referee: {counts_soak} != {counts_ref}"
+    )
+    assert partition_soak == partition_ref
+    # the faults actually happened and actually recovered
+    assert rs.client.resyncs >= 1, "epoch desync never exercised the resync path"
+    assert rs.client.delta_solves >= 1, "the delta path never carried a solve"
+    assert SOLVER_FALLBACK.value({"reason": "admission_rejected"}) >= 1
+
+
+@pytest.mark.soak
+def test_chaos_soak_concurrent_client_partial_failure():
+    """Coalesced-batch partial failure: two clients share the server; one
+    connection's response is corrupted mid-batch while its sibling's
+    concurrent solve must complete untouched and both end decision-
+    identical to in-process (one lane's failure never poisons another)."""
+    sock_path = tempfile.mktemp(suffix=".pair.sock")
+    srv = SolverServer(sock_path)
+    srv.start()
+    proxy_path = tempfile.mktemp(suffix=".pairproxy.sock")
+    proxy = FaultyProxy(proxy_path, sock_path)
+    try:
+        referee = _in_process_parts(8)
+        pools, ibp, pods = _problem(8)
+        # victim rides the proxy (its next response gets corrupted);
+        # sibling dials the server directly, concurrently
+        victim = SolverClient(proxy_path, request_timeout=120.0, max_retries=2)
+        sibling = SolverClient(sock_path, request_timeout=120.0)
+        victim.solve(pools, ibp, pods, force_oracle=True)  # epoch established
+        proxy.set_fault("corrupt", once=True)
+        results = {}
+        errors = {}
+
+        def solve(name, client):
+            try:
+                results[name] = client.solve(pools, ibp, pods, force_oracle=True)
+            except Exception as e:  # the victim may legitimately fail
+                errors[name] = e
+
+        threads = [
+            threading.Thread(target=solve, args=("victim", victim), daemon=True),
+            threading.Thread(target=solve, args=("sibling", sibling), daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        # the sibling lane is untouched by the victim's corruption
+        assert "sibling" in results
+        assert _remote_parts(results["sibling"], pods) == referee
+        # the victim either recovered via reconnect-retry in the same call
+        # or surfaced a clean typed error; either way the NEXT solve is
+        # decision-identical again
+        got = victim.solve(pools, ibp, pods, force_oracle=True)
+        assert _remote_parts(got, pods) == referee
+        victim.close()
+        sibling.close()
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+def test_half_open_probe_landing_on_retry_recloses_breaker(server):
+    """Review regression (finding: stranded probe): a half-open probe
+    that lands on an admission RETRY must resolve the probe — the
+    transport round-tripped, so the breaker closes and pacing is the
+    admission backoff's job. Without record_success the probe would be
+    stranded and every caller wedged in-process for an extra cooldown."""
+    server.admission.max_inflight = 0  # healthy but shedding
+    t = {"now": 1000.0}
+    rs = ResilientSolver(
+        server.socket_path,
+        failure_threshold=1,
+        cooldown_seconds=10.0,
+        request_timeout_seconds=5.0,
+        clock=lambda: t["now"],
+    )
+    rs.client.backoff_base = 0.01
+    pools, ibp, pods = _problem(3)
+    server.stop()  # a real outage trips the breaker
+    rs.solve(pools, ibp, pods, force_oracle=True)
+    assert rs.breaker.state == "open"
+    server.start()  # back, but still overloaded
+    t["now"] += 11.0  # cooldown elapsed -> half-open probe
+    r = rs.solve(pools, ibp, pods, force_oracle=True)
+    assert not r.pod_errors and rs.last_used == "oracle"
+    assert rs.breaker.state == "closed", (
+        "RETRY answer must resolve the half-open probe, not strand it"
+    )
+    # capacity restored + hint elapsed -> sidecar serves immediately
+    server.admission.max_inflight = 4
+    t["now"] += 3600.0
+    r = rs.solve(pools, ibp, pods, force_oracle=True)
+    assert rs.last_used == "sidecar" and not r.pod_errors
+
+
+def test_pre_epoch_server_downgrades_client_to_snapshots(server, monkeypatch):
+    """Review regression (mixed-version rollout, control plane upgraded
+    first): a pre-epoch server answers 'unknown kind 6' to SOLVE_DELTA
+    and silently ignores the epoch key on snapshots. The client must
+    fall back to the plain snapshot IN THE SAME CALL and disable epoch
+    mode for its lifetime — never retry deltas into the same error and
+    feed the breaker against a healthy old sidecar."""
+    from karpenter_tpu.solver import service as svc
+
+    def legacy_handle(self, conn):
+        # the pre-epoch _handle: PING + SOLVE only, no epoch storage
+        while True:
+            try:
+                kind, req_id, payload = self._recv_frame_idle(conn)
+            except socket.timeout as e:
+                raise ProtocolError(f"peer stalled mid-frame: {e}") from e
+            if kind == KIND_PING:
+                self._send_response(conn, KIND_PONG, b"ready", req_id)
+                continue
+            if kind != KIND_SOLVE:
+                self._send_response(
+                    conn, KIND_ERROR, f"unknown kind {kind}".encode(), req_id
+                )
+                continue
+            result = self._solve(payload, req_id)
+            self._send_response(conn, KIND_RESULT, result, req_id)
+
+    monkeypatch.setattr(svc.SolverServer, "_handle", legacy_handle)
+    monkeypatch.setattr(
+        svc.SolverServer, "_store_epoch", lambda self, *a, **k: None
+    )
+    c = SolverClient(server.socket_path, request_timeout=120.0)
+    pools, ibp, pods = _problem(6)
+    referee = _in_process_parts(6)
+    r1 = c.solve(pools, ibp, pods, force_oracle=True)  # snapshot, epoch key ignored
+    assert _remote_parts(r1, pods) == referee
+    r2 = c.solve(pools, ibp, pods, force_oracle=True)  # delta refused -> downgrade
+    assert _remote_parts(r2, pods) == referee
+    assert c.epochs_enabled is False and c.resyncs == 1
+    r3 = c.solve(pools, ibp, pods, force_oracle=True)  # plain snapshot from now on
+    assert _remote_parts(r3, pods) == referee
+    assert c.resyncs == 1, "must not keep probing deltas at an old server"
+    c.close()
+
+
+def test_admission_gate_idle_escape_after_pathological_observation():
+    """Review regression: one solve slower than max_cost_seconds pushes
+    the observed-cost EWMA above the budget; since observe() only fires
+    on completed solves, rejecting at depth 0 would be PERMANENT. An
+    idle gate must always admit (serial execution can't oversubscribe),
+    letting the EWMA recover from real measurements."""
+    from karpenter_tpu.solver import epochs as epochs_mod
+
+    g = epochs_mod.AdmissionGate(max_inflight=4, max_cost_seconds=10.0)
+    g.observe(500.0)  # pathological: one solve blew the whole budget
+    token, hint, depth = g.try_admit(100)
+    assert token is not None, "idle gate must admit despite the EWMA"
+    # with one in flight the cost budget binds again
+    t2, hint2, _ = g.try_admit(100)
+    assert t2 is None and hint2 > 0
+    g.release(token)
+    t3, _, _ = g.try_admit(100)
+    assert t3 is not None
+    g.release(t3)
+    assert g.depth() == 0
+
+
+def test_drain_closes_connection_after_any_answered_frame(server, monkeypatch):
+    """Review regression: the one-refusal-then-close drain bound must
+    cover PING traffic too — a peer pinging in a tight loop during drain
+    must lose its connection after one answer, not hold the handler
+    thread past stop()'s bounded join."""
+    original = SolverServer._solve
+
+    def slow(self, payload, req_id=0):
+        time.sleep(1.0)
+        return original(self, payload, req_id)
+
+    monkeypatch.setattr(SolverServer, "_solve", slow)
+    pools, ibp, pods = _problem(2)
+    a = SolverClient(server.socket_path, request_timeout=120.0)
+    t = threading.Thread(
+        target=lambda: a.solve(pools, ibp, pods, force_oracle=True), daemon=True
+    )
+    t.start()
+    time.sleep(0.2)  # solve in flight holds the drain window open
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(10)
+    sock.connect(server.socket_path)
+    stopper = threading.Thread(target=server.stop, daemon=True)
+    stopper.start()
+    time.sleep(0.2)  # drain window open
+    sock.sendall(MAGIC + struct.pack("<III", KIND_PING, 5, 0))
+    head = _read_exact(sock, 16)
+    kind, rid, length = struct.unpack("<III", head[4:])
+    _read_exact(sock, length)
+    assert (kind, rid) == (KIND_PONG, 5)  # one answer...
+    got = b""
+    try:
+        got = sock.recv(1)
+    except ConnectionError:
+        pass
+    assert got == b"", "connection must close after the drained answer"
+    sock.close()
+    t.join(timeout=30)
+    stopper.join(timeout=30)
+    a.close()
