@@ -425,6 +425,207 @@ def bench_epoch_delta(n_nodes: int, n_pods: int) -> dict:
     return row
 
 
+# --- fleet-axis serving (solver/fleet.py) ----------------------------------
+
+_FLEET_SCRIPT = r"""
+import json, sys, tempfile, threading, time
+sys.path.insert(0, ".")
+cfg = json.loads(sys.argv[1])
+
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.solver import epochs
+from karpenter_tpu.solver.service import SolverClient, SolverServer
+from karpenter_tpu.testing import fixtures
+
+def problem(cpu):
+    # the shared scan-path fixture — same shape the fleet tests and the
+    # fleet[runtime] IR kit measure (fixtures.make_self_spread_pods)
+    fixtures.reset_rng(5)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    pods = fixtures.make_self_spread_pods(cfg["pods_per_lane"], cpu)
+    return pools, {"default": its}, pods
+
+def run(window, clients, per_client, burst=False):
+    path = tempfile.mktemp(suffix=".fleetbench.sock")
+    # the lane budget tracks the offered concurrency (capped at the
+    # prewarmed 8-lane bucket): a FULL window wakes the leader at once,
+    # so steady-state coalescing pays ~zero window latency; only a
+    # straggler round eats the (small) timeout
+    srv = SolverServer(
+        path, fleet_window_seconds=window,
+        fleet_max_lanes=max(2, min(8, clients)),
+        admission=epochs.AdmissionGate(max_inflight=256,
+                                       max_cost_seconds=1e9),
+    )
+    srv.start()
+    profiles = [f"{(k % 8) + 1}00m" for k in range(clients)]
+    # warm: compile the scan (and, with a window, the vmapped) shapes
+    # outside the timed region — steady state is the serving number
+    warm_n = min(8, clients) if window else 1
+    wb = threading.Barrier(warm_n)
+    def warm(cpu):
+        c = SolverClient(path, request_timeout=1200.0)
+        p = problem(cpu); wb.wait(); c.solve(*p); c.close()
+    wt = [threading.Thread(target=warm, args=(profiles[i],), daemon=True)
+          for i in range(warm_n)]
+    [t.start() for t in wt]; [t.join(timeout=1200) for t in wt]
+
+    barrier = threading.Barrier(clients)
+    errs = []
+    def client(cpu):
+        try:
+            c = SolverClient(path, request_timeout=1200.0)
+            # pre-connect with retry: a 64-client burst overflows the
+            # UDS listen backlog (8); connects must spread, solves burst
+            for _ in range(200):
+                try:
+                    c.connect()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            p = problem(cpu)
+            barrier.wait()
+            for _ in range(per_client):
+                if burst:
+                    # synchronized rounds: every client submits together
+                    # (aligned reconcile ticks / simulation sweeps — the
+                    # arrival pattern whose windows actually fill)
+                    barrier.wait()
+                c.solve(*p)
+            c.close()
+        except Exception as e:
+            errs.append(repr(e))
+    threads = [threading.Thread(target=client, args=(profiles[i],),
+                                daemon=True) for i in range(clients)]
+    t0 = time.monotonic()
+    [t.start() for t in threads]
+    [t.join(timeout=1200) for t in threads]
+    dt = time.monotonic() - t0
+    srv.stop()
+    if errs:
+        raise RuntimeError(errs[0])
+    return round(clients * per_client / dt, 1)
+
+out = {"solo": {}, "coalesced": {}, "solo_burst": {}, "coalesced_burst": {}}
+for clients, per_client in cfg["loads"]:
+    out["solo"][str(clients)] = run(0.0, clients, per_client)
+    out["coalesced"][str(clients)] = run(cfg["window"], clients, per_client)
+for clients, per_client in cfg.get("burst_loads", []):
+    out["solo_burst"][str(clients)] = run(0.0, clients, per_client,
+                                          burst=True)
+    out["coalesced_burst"][str(clients)] = run(cfg["window"], clients,
+                                               per_client, burst=True)
+
+# kernel dispatch-level lanes/s: the device-path number that transfers
+# to accelerator hardware (host encode/decode excluded on both sides)
+import numpy as np, jax
+import __graft_entry__ as ge
+from karpenter_tpu.solver import fleet
+from karpenter_tpu.solver import tpu_kernel as K
+tb, st, xs, _, _ = ge._small_problem(n_pods=cfg["pods_per_lane"])
+B = 8
+xs_lanes = [xs._replace(prequests=xs.prequests * (1 + k % 3))
+            for k in range(B)]
+solo_fn = jax.jit(K.solve_scan)
+for x in xs_lanes:
+    jax.block_until_ready(solo_fn(tb, st, x)[0])
+st_b, xs_b = fleet.stack_lanes([st] * B, xs_lanes)
+st_b, xs_b = fleet.shard_lanes(st_b, xs_b)
+jax.block_until_ready(fleet.fleet_fn(True)(tb, st_b, xs_b)[0])
+N = cfg["kernel_reps"]
+t0 = time.monotonic()
+for _ in range(N):
+    for x in xs_lanes:
+        got = solo_fn(tb, st, x)
+    jax.block_until_ready(got[0])
+t_solo = time.monotonic() - t0
+t0 = time.monotonic()
+for _ in range(N):
+    got = fleet.fleet_fn(True)(tb, st_b, xs_b)
+jax.block_until_ready(got[0])
+t_coal = time.monotonic() - t0
+out["kernel_lane_solves_per_sec"] = {
+    "solo": round(N * B / t_solo, 1),
+    "coalesced": round(N * B / t_coal, 1),
+    "speedup": round(t_solo / t_coal, 2),
+}
+out["devices"] = jax.device_count()
+print(json.dumps(out))
+"""
+
+
+def bench_fleet(quick: bool) -> dict:
+    """The fleet-axis serving row (solver/fleet.py): solves/sec through
+    ONE SolverServer at 1/8/64 concurrent clients, coalesced (batch
+    window -> one vmapped dispatch per round) vs the solo-dispatch
+    baseline (fleet disabled), on both a 1-device and an 8-virtual-
+    device `fleet` mesh, plus the kernel dispatch-level lanes/sec.
+
+    Honesty note for this 1-core CPU container: the vmapped lanes'
+    tensor work SERIALIZES on the single core, so the measured speedup
+    is only the dispatch-overhead amortization (~1.2-1.6x at the kernel
+    level); on a real multi-chip mesh the lane axis shards with zero
+    collectives (dryrun_multichip phase 4) and the win scales with the
+    device count. The row records both device configs so the hardware
+    number lands in the same schema."""
+    row: dict[str, dict] = {}
+    for ndev in (1,) if quick else (1, 8):
+        if ndev == 1:
+            loads = [(1, 3), (4, 2)] if quick else [(1, 6), (8, 4), (64, 1)]
+            burst = [] if quick else [(8, 4)]
+        else:
+            # the virtual 8-device mesh shares ONE core: free-running
+            # clients form partial windows whose every pow-2 lane bucket
+            # compiles its own SHARDED program mid-flight — a compile
+            # storm that blows client deadlines without measuring
+            # anything real. Burst arrivals fill the window, so one
+            # warmed (B=8) sharded shape serves the whole run — the only
+            # honest serving measurement this box can make on a mesh
+            # (steady-arrival behavior is covered by the 1-device rows).
+            loads = [(1, 3)]
+            burst = [(8, 2)]
+        cfg = {
+            "loads": loads,
+            # synchronized-burst arrivals (aligned reconcile ticks,
+            # simulation sweeps, setsweep proposal rounds): the pattern
+            # whose windows actually fill — free-running clients on this
+            # 1-core box drift apart by a full host-encode each, so
+            # their lanes can never arrive inside one window
+            "burst_loads": burst,
+            "window": 0.02,
+            "pods_per_lane": 8,
+            "kernel_reps": 10 if quick else 30,
+        }
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={ndev}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        log(f"  fleet: {ndev}-device mesh, loads {loads} ...")
+        out = subprocess.run(
+            [sys.executable, "-c", _FLEET_SCRIPT, json.dumps(cfg)],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=3600,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr[-4000:])
+        got = json.loads(out.stdout.strip().splitlines()[-1])
+        log(
+            f"    solo {got['solo']} vs coalesced {got['coalesced']} "
+            f"solves/s; kernel lanes/s {got['kernel_lane_solves_per_sec']}"
+        )
+        row[f"devices_{ndev}"] = got
+    return row
+
+
 def merge_detail(rows: dict) -> None:
     """Merge bench rows into BENCH_DETAIL.json without clobbering the
     other configs (the --consolidation section updates its row next to
@@ -469,6 +670,16 @@ def main() -> None:
         ),
     )
     ap.add_argument(
+        "--fleet",
+        action="store_true",
+        help=(
+            "fleet-axis serving section only: solves/sec at concurrent "
+            "clients through one SolverServer, coalesced vs solo "
+            "dispatch, 1- and 8-device mesh (writes c11 into "
+            "BENCH_DETAIL.json)"
+        ),
+    )
+    ap.add_argument(
         "--epoch",
         action="store_true",
         help=(
@@ -480,6 +691,13 @@ def main() -> None:
     args = ap.parse_args()
 
     detail: dict[str, dict] = {}
+
+    if args.fleet:
+        log("== fleet: coalesced vs solo dispatch through one SolverServer ==")
+        row = bench_fleet(args.quick)
+        merge_detail({"c11_fleet_throughput": row})
+        print(json.dumps(row, indent=2))
+        return
 
     if args.epoch:
         n_nodes, n_pods = (200, 48) if args.quick else (2000, 200)

@@ -70,6 +70,18 @@ METRIC_POLICY: dict[str, str] = {
     "epoch_repeat_table_uploads": "exact",
     "epoch_repeat_pod_table_uploads": "exact",
     "epoch_repeat_pod_batch_uploads": "ceiling",
+    # fleet coalescing accounting (analysis/ir.py fleet_runtime_metrics):
+    # a coalesced batch window (solver/fleet.py) shares one device-table
+    # materialization — the repeat window re-uploads nothing, runs ONE
+    # vmapped dispatch, and the same-bucket zero-compile contract holds
+    # for the lane-batched entry. The first window's upload count is a
+    # ceiling: a cache-miss race (both lanes encode before either's put
+    # lands) may legally upload per lane once.
+    "fleet_first_window_table_uploads": "ceiling",
+    "fleet_repeat_window_table_uploads": "exact",
+    "fleet_repeat_window_dispatches": "exact",
+    "fleet_repeat_window_traces": "exact",
+    "fleet_repeat_window_compiles": "exact",
 }
 
 

@@ -92,6 +92,15 @@ _METRIC_RULE = {
     "epoch_repeat_table_uploads": "ir-transfer",
     "epoch_repeat_pod_table_uploads": "ir-transfer",
     "epoch_repeat_pod_batch_uploads": "ir-transfer",
+    # fleet coalescing accounting (fleet_runtime_metrics): a coalesced
+    # window shares one device-table materialization (repeat window =
+    # zero table uploads), runs ONE vmapped dispatch, and a repeat
+    # same-bucket batch hits every jit cache
+    "fleet_first_window_table_uploads": "ir-transfer",
+    "fleet_repeat_window_table_uploads": "ir-transfer",
+    "fleet_repeat_window_dispatches": "ir-transfer",
+    "fleet_repeat_window_traces": "ir-retrace",
+    "fleet_repeat_window_compiles": "ir-retrace",
 }
 
 _FORBIDDEN_EXACT = frozenset(
@@ -522,6 +531,31 @@ def _ep_typeok(kit: ProblemKit) -> tuple:
     )
 
 
+def _ep_fleet(kit: ProblemKit) -> tuple:
+    """The lane-batched serving entry (solver/fleet.py) at a pinned
+    8-lane bucket: vmap(solve_scan) over the generic kit's state/pod
+    batch replicated per lane. The vmapped program must keep the solo
+    kernel's structure — one scan, one exact-verify while loop — with
+    the carry scaled by the lane count; extra loops would mean the lane
+    axis leaked into control flow instead of batching it."""
+    import functools
+
+    import jax
+
+    from karpenter_tpu.solver import fleet as fleet_mod
+    from karpenter_tpu.solver import tpu_kernel as K
+
+    B = 8
+    st_b, xs_b = fleet_mod.stack_lanes([kit.st] * B, [kit.xs] * B)
+    return (
+        jax.vmap(
+            functools.partial(K.solve_scan, relax=False),
+            in_axes=(None, 0, 0),
+        ),
+        (kit.tb, st_b, xs_b),
+    )
+
+
 def _ep_gather_xs(kit: ProblemKit) -> tuple:
     from karpenter_tpu.solver import tpu as T
 
@@ -536,6 +570,7 @@ _RUNS_PATH = "karpenter_tpu/solver/tpu_runs.py"
 _TPU_PATH = "karpenter_tpu/solver/tpu.py"
 _SWEEP_PATH = "karpenter_tpu/controllers/disruption/sweep.py"
 _SETSWEEP_PATH = "karpenter_tpu/controllers/disruption/setsweep.py"
+_FLEET_PATH = "karpenter_tpu/solver/fleet.py"
 
 ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint(
@@ -557,6 +592,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("_set_sweep_kernel", _SETSWEEP_PATH, "generic", _ep_set_sweep),
     EntryPoint("_typeok_chunk", _TPU_PATH, "generic", _ep_typeok),
     EntryPoint("_gather_xs", _TPU_PATH, "generic", _ep_gather_xs),
+    EntryPoint("fleet_solve_scan[B=8]", _FLEET_PATH, "generic", _ep_fleet),
 )
 
 # the trace-time-static contract pairs: relax=True must contain EXACTLY
@@ -676,6 +712,97 @@ def epoch_runtime_metrics() -> dict[str, int]:
         "epoch_repeat_table_uploads": repeat["_tables"],
         "epoch_repeat_pod_table_uploads": repeat["_upload_pod_tables"],
         "epoch_repeat_pod_batch_uploads": repeat["_pod_xs_with_idx"],
+    }
+
+
+def _make_fleet_sched(table_cache=None, fleet=None):
+    """(TpuScheduler, pods) for the fleet runtime contract: the shared
+    scan-path fixture (fixtures.make_self_spread_pods — self-selecting
+    zone spread forces the exact per-pod SCAN path, the only path the
+    coalescer serves)."""
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import TpuScheduler
+    from karpenter_tpu.testing import fixtures
+
+    fixtures.reset_rng(7)
+    its = construct_instance_types(sizes=[2])
+    pool = fixtures.node_pool(name="default")
+    pods = fixtures.make_self_spread_pods(6)
+    topo = Topology([pool], {"default": its}, pods)
+    return (
+        TpuScheduler(
+            [pool], {"default": its}, topo,
+            table_cache=table_cache, fleet=fleet,
+        ),
+        pods,
+    )
+
+
+def fleet_runtime_metrics() -> dict[str, int]:
+    """Entry `fleet[runtime]`: the coalesced-window transfer/retrace
+    contract (solver/fleet.py). Two concurrent scan-path lanes through
+    one FleetCoalescer + shared DeviceTableCache — exactly how a
+    fleet-serving SolverServer stacks sibling solves:
+
+    - the FIRST window may upload tables per lane (a cache-miss race is
+      legal: both lanes can encode before either's put lands), ceiling 2;
+    - a REPEAT window of the same table encoding uploads exactly ZERO
+      per-class tables (every lane hits the server's resident cache —
+      one materialization serves the whole window),
+    - runs exactly ONE vmapped dispatch, and
+    - retraces/compiles nothing (the same-bucket zero-compile contract
+      extends to the lane-batched entry)."""
+    import threading
+
+    from karpenter_tpu import tracing as tracing_mod
+    from karpenter_tpu.solver import epochs as epochs_mod
+    from karpenter_tpu.solver import fleet as fleet_mod
+    from karpenter_tpu.solver.tpu import TpuScheduler
+
+    cache = epochs_mod.DeviceTableCache()
+    coalescer = fleet_mod.FleetCoalescer(window_seconds=10.0, max_lanes=2)
+
+    def window() -> None:
+        lanes = [_make_fleet_sched(cache, coalescer) for _ in range(2)]
+        errors: list[BaseException] = []
+
+        def run(sched, pods) -> None:
+            try:
+                sched.solve(pods)
+            except BaseException as e:  # surfaced below, never swallowed
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=lane, daemon=True)
+            for lane in lanes
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        if errors:
+            raise errors[0]
+        if not all(s.last_used_fleet for s, _ in lanes):
+            raise RuntimeError(
+                "fleet runtime contract: lanes did not coalesce"
+            )
+
+    with count_method_calls(TpuScheduler, ("_tables",)) as first:
+        window()
+    d0 = tracing_mod.SOLVE_DISPATCHES.value({"path": "fleet"})
+    with count_method_calls(TpuScheduler, ("_tables",)) as repeat:
+        with trace_events() as ev:
+            window()
+    dispatches = int(
+        tracing_mod.SOLVE_DISPATCHES.value({"path": "fleet"}) - d0
+    )
+    return {
+        "fleet_first_window_table_uploads": first["_tables"],
+        "fleet_repeat_window_table_uploads": repeat["_tables"],
+        "fleet_repeat_window_dispatches": dispatches,
+        "fleet_repeat_window_traces": ev.traces,
+        "fleet_repeat_window_compiles": ev.compiles,
     }
 
 
@@ -827,6 +954,10 @@ def measure(
             measured["epoch[runtime]"] = epoch_runtime_metrics()
         except Exception as e:
             errors.append(f"epoch[runtime]: {type(e).__name__}: {e}")
+        try:
+            measured["fleet[runtime]"] = fleet_runtime_metrics()
+        except Exception as e:
+            errors.append(f"fleet[runtime]: {type(e).__name__}: {e}")
     return measured, findings, errors
 
 
@@ -876,6 +1007,7 @@ def _entry_paths() -> dict[str, str]:
     paths["solve[runtime]"] = _TPU_PATH
     paths["setsweep[runtime]"] = _SETSWEEP_PATH
     paths["epoch[runtime]"] = "karpenter_tpu/solver/epochs.py"
+    paths["fleet[runtime]"] = _FLEET_PATH
     return paths
 
 

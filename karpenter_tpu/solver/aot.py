@@ -180,6 +180,8 @@ def prewarm(
     max_pods: int = 1024,
     min_pods: int = 64,
     include_sweeps: bool = True,
+    include_fleet: bool = False,
+    fleet_lane_buckets: tuple[int, ...] = (2, 8),
     stop: Optional[threading.Event] = None,
     progress: Optional[Callable[[str, float], None]] = None,
 ) -> dict:
@@ -288,6 +290,38 @@ def prewarm(
                         tb, st_s, xs, relax=relax
                     ).compile(),
                 )
+                if include_fleet and P == buckets.bucket(min_pods, floor=64):
+                    # the lane-batched entry (solver/fleet.py): the
+                    # vmapped solve_scan at the pow-2 lane buckets a
+                    # fleet-serving SolverServer dispatches, compiled at
+                    # the smallest pod rung (a coalesced window of a
+                    # different rung pays its own one-time compile and
+                    # the persistent cache then holds it)
+                    from karpenter_tpu.solver import fleet as fleet_mod
+
+                    for B in fleet_lane_buckets:
+                        st_list = [sched._init_state(problem, N_scan)] * B
+                        st_b, xs_b = fleet_mod.stack_lanes(st_list, [xs] * B)
+                        # compile the program the SERVING dispatch will
+                        # actually run: shard_lanes is a no-op on one
+                        # device, and on a mesh the jit/persistent-cache
+                        # keys include the input shardings — prewarming
+                        # only the unsharded layout would leave the first
+                        # coalesced window to compile mid-serving
+                        st_b, xs_b = fleet_mod.shard_lanes(st_b, xs_b)
+                        name = (
+                            f"fleet_solve_scan[relax={relax}]"
+                            f"@B={B},P={P},N={N_scan}"
+                        )
+                        compile_combo(
+                            name,
+                            sig,
+                            lambda st_b=st_b, xs_b=xs_b, relax=relax: (
+                                fleet_mod.fleet_fn(relax)
+                                .lower(tb, st_b, xs_b)
+                                .compile()
+                            ),
+                        )
         if include_sweeps:
             _prewarm_sweeps(compile_combo)
         completed = True

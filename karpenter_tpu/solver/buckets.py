@@ -89,6 +89,13 @@ def bucket_keys(n: int) -> int:
     return bucket(n, floor=8)
 
 
+def bucket_lanes(n: int) -> int:
+    """Fleet-lane rung (solver/fleet.py): the pow-2 lane count a
+    coalesced batch window pads to (floor 2 — a single lane never
+    dispatches the vmapped entry; it falls back to the solo path)."""
+    return bucket(n, floor=2)
+
+
 def ladder(lo: int, hi: int, floor: int = 8) -> list[int]:
     """Every rung from bucket(lo) up to bucket(hi) inclusive."""
     out = []

@@ -378,6 +378,93 @@ _FP_SKIP = frozenset(
     {"scheduler", "pods", "vocab", "table", "vgroups", "hgroups", "rt_tier_reqs"}
 )
 
+# Additional skips for the TABLE-level fingerprint (fleet lane grouping,
+# solver/fleet.py): the per-pod identity columns and per-encode-class
+# tables listed here ride the per-LANE State/PodX side of a vmapped
+# dispatch — they are gathered into each lane's own PodX from each
+# lane's own _dev_tables — so two requests that differ only in them can
+# still share ONE Tables pytree on device. Everything a shared
+# tb (tpu.py _tables) or the lane State SHAPES derive from stays hashed:
+# templates/types/offerings, topology group tables, the relax-tier
+# tables (PodX.rrow indexes the SHARED tb.rt_* rows, so those arrays
+# must be byte-equal across lanes), vocab/resource layouts, and every
+# scalar dim.
+_TABLE_FP_SKIP = _FP_SKIP | frozenset(
+    {
+        "pod_class",
+        "srow",
+        "class_reps",
+        "rcls_of",
+        "rclass_creps",
+        "preq_c",
+        "prequests_c",
+        "ptol_t_c",
+        "ptol_e_c",
+        "ptopo_kind_c",
+        "ptopo_gid_c",
+        "ptopo_sel_c",
+        "pinv_h_c",
+        "pown_h_c",
+        "sel_rows_v",
+        "sel_rows_h",
+        "php_own_c",
+        "php_conf_c",
+    }
+)
+
+
+def _field_digest(problem, name: str, cache: dict) -> bytes:
+    got = cache.get(name)
+    if got is None:
+        h = hashlib.blake2b(digest_size=16)
+        _feed(h, getattr(problem, name))
+        got = h.digest()
+        cache[name] = got
+    return got
+
+
+def _fingerprint(problem, skip: frozenset) -> str:
+    """Hash-of-field-hashes with a per-problem-instance digest memo: the
+    serving hot path computes TWO fingerprints with different skip sets
+    per solve — problem_fingerprint for the table-cache lookup, then
+    table_fingerprint for the fleet window key — so the expensive part
+    (a blake2b pass over each MB-scale array) runs once per FIELD and
+    the second fingerprint only combines ~a hundred cached 16-byte
+    digests. Safe because an EncodedProblem is built fresh per solve and
+    not mutated between the two calls (the CLAUDE.md _ktpu_* concern is
+    cross-solve, and cross-solve always re-encodes)."""
+    from karpenter_tpu.solver import buckets
+
+    cache = getattr(problem, "_ktpu_fp_cache", None)
+    if cache is None:
+        cache = {}
+        problem._ktpu_fp_cache = cache
+    h = hashlib.blake2b(digest_size=16)
+    _feed(h, bool(buckets.enabled()))
+    for f in dataclasses.fields(problem):
+        if f.name in skip:
+            continue
+        h.update(f.name.encode())
+        h.update(_field_digest(problem, f.name, cache))
+    meta = cache.get("__meta__")
+    if meta is None:
+        mh = hashlib.blake2b(digest_size=16)
+        vocab = problem.vocab
+        _feed(mh, (vocab.keys, vocab.values, vocab.words_per_key))
+        table = problem.table
+        _feed(mh, (table.names, table.scale))
+        for g in problem.vgroups:
+            _feed(
+                mh,
+                (g.kid, g.skew, g.min_domains, tuple(g.filt), g.group.type.value),
+            )
+        for g in problem.hgroups:
+            _feed(mh, (g.skew, bool(g.inverse), tuple(g.filt)))
+        meta = mh.digest()
+        cache["__meta__"] = meta
+    h.update(meta)
+    return h.hexdigest()
+
 
 def problem_fingerprint(problem) -> str:
     """Content hash of every encoded input the device tables derive from
@@ -388,24 +475,22 @@ def problem_fingerprint(problem) -> str:
     an instance-type change — perturbs some encoded array and misses.
     Hash cost is host memory bandwidth over a few MB of tables, orders
     below the tunnel upload + typeok dispatches a hit skips."""
-    from karpenter_tpu.solver import buckets
+    return _fingerprint(problem, _FP_SKIP)
 
-    h = hashlib.blake2b(digest_size=16)
-    _feed(h, bool(buckets.enabled()))
-    for f in dataclasses.fields(problem):
-        if f.name in _FP_SKIP:
-            continue
-        _feed(h, f.name)
-        _feed(h, getattr(problem, f.name))
-    vocab = problem.vocab
-    _feed(h, (vocab.keys, vocab.values, vocab.words_per_key))
-    table = problem.table
-    _feed(h, (table.names, table.scale))
-    for g in problem.vgroups:
-        _feed(h, (g.kid, g.skew, g.min_domains, tuple(g.filt), g.group.type.value))
-    for g in problem.hgroups:
-        _feed(h, (g.skew, bool(g.inverse), tuple(g.filt)))
-    return h.hexdigest()
+
+def table_fingerprint(problem) -> str:
+    """The fleet-lane grouping key (solver/fleet.py): like
+    problem_fingerprint but EXCLUDING the per-pod / per-encode-class
+    columns that ride each lane's own PodX. Two problems with equal
+    table fingerprints share one `Tables` pytree (vmap in_axes=None) and
+    produce shape-compatible States, so their solves can stack on a
+    fleet axis; distinct pending-pod batches — different requests,
+    names, counts within a pow-2 rung — still coalesce, which is exactly
+    the phase-4 shape (__graft_entry__.py:274). Skipping MORE than tb
+    reads would be unsound (lanes could share a wrong tb); skipping
+    LESS only narrows coalescing, so the skip list is the conservative
+    per-pod set."""
+    return _fingerprint(problem, _TABLE_FP_SKIP)
 
 
 class DeviceTableCache:

@@ -1,0 +1,555 @@
+"""Fleet-axis serving: coalesce concurrent solves into one vmapped
+mesh dispatch (ROADMAP open item 2).
+
+`dryrun_multichip` phase 4 proved the shape: independent solve lanes —
+distinct request profiles against one cluster — batched on a leading
+`fleet` axis and executed by ONE `jax.vmap(solve_scan)` dispatch, every
+lane bit-identical to its solo run, with zero cross-device collectives
+(the batch axis shards cleanly over a mesh). This module promotes that
+dry-run into the production serving path:
+
+- **The shared lane-stack/dispatch core** (`stack_lanes`,
+  `shard_lanes`, `fleet_dispatch`, `fleet_fn`): the ONE implementation
+  both `__graft_entry__.dryrun_multichip` phase 4 and the live
+  coalescer drive, so the dry-run and production paths cannot drift.
+- **`FleetCoalescer`**: a batch window in front of the scan-path solve
+  loop. Concurrent solves (many control planes / simulation lanes
+  against one `SolverServer`) that share a TABLE fingerprint
+  (`epochs.table_fingerprint` — the cluster tables, topology groups,
+  relax-tier tables; NOT the per-pod columns, which ride each lane's
+  own PodX) wait up to `window_seconds` for siblings, then stack onto a
+  pow-2 lane bucket (`solver/buckets.py` ladder, so the AOT prewarm in
+  `solver/aot.py` covers the vmapped entry and steady state stays
+  zero-compile) and run their requeue rounds through shared dispatches.
+  Same-epoch solves share one device-table materialization: the epoch
+  machinery makes their encodings byte-equal, so the server's
+  `DeviceTableCache` hit hands every lane the SAME resident tables and
+  the window re-uploads nothing.
+
+Eligibility and isolation contract:
+
+- Only SCAN-path solves coalesce (`TpuScheduler` gates on
+  `use_runs=False`): the runs path grows claim slots mid-solve
+  (host-driven regrow), which cannot be shared across lanes. Runs-path
+  solves, strict-reserved problems (oracle-gated before encode), and
+  lanes whose table fingerprints differ (mixed relax shapes that won't
+  stack simply land in different windows) fall through to the existing
+  solo path untouched.
+- Per-lane deadline/poison semantics survive coalescing: a lane past
+  its deadline finishes `timed_out` with exactly the partial decisions
+  the solo loop would return; a lane whose host-side work raises is
+  errored alone; a lane that overflows its claim slots leaves the batch
+  and re-solves solo (the solo loop's own N-doubling restart — claim
+  decisions are N-invariant, so the final decisions match). A failure
+  of the BATCHED dispatch itself returns every lane to the solo path —
+  degraded throughput, never a wrong or missing answer.
+- Decisions are bit-identical to solo by construction: the vmapped
+  program runs the same `solve_scan` per lane (tests/test_fleet.py pins
+  the parity matrix; phase 4 pins it on a sharded mesh).
+
+Trace shape (satellite: the coalescing wait must be visible): each
+request's trace keeps its own wire id and gains a `fleet_dispatch` span
+covering window wait + shared execution, plus a `fleet_window` event
+carrying (lanes, bucket, window wait, rounds) — a client waterfall
+shows the coalescing wait instead of unexplained dead time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from karpenter_tpu import logging as klog
+from karpenter_tpu import metrics, tracing
+from karpenter_tpu.solver import buckets, epochs
+
+_log = klog.root.named("solver.fleet")
+
+# -- fleet metrics (docs/observability.md catalogs these) --------------------
+
+FLEET_SOLVES = metrics.REGISTRY.counter(
+    "karpenter_fleet_solves_total",
+    "Scan-path solves offered to the fleet coalescer, by outcome: "
+    "coalesced (shared vmapped dispatch), solo_window (no sibling "
+    "arrived in the window), fallback (overflow/lane error returned the "
+    "lane to the solo path).",
+    ("mode",),
+)
+FLEET_LANES = metrics.REGISTRY.histogram(
+    "karpenter_fleet_lanes_per_dispatch",
+    "Real lanes per coalesced fleet dispatch (before pow-2 padding).",
+)
+FLEET_WINDOW_WAIT = metrics.REGISTRY.histogram(
+    "karpenter_fleet_window_wait_seconds",
+    "Per-lane wall-clock from window entry to coalesced-result handoff "
+    "(the coalescing latency a client trades for shared dispatches).",
+)
+
+# the hard cap on a non-leader lane's result wait. Before the leader
+# drains the window a waiter that exhausts its deadline-shaped budget
+# WITHDRAWS (removes itself from the lane list and solves solo); after
+# the drain the leader owns the lane, so the waiter takes the handoff
+# under this cap — the leader sets every drained lane's done event in a
+# finally, so exceeding it means the leader thread was destroyed
+# un-Pythonically, and the lane falls back to the solo path
+_RESULT_WAIT_CAP_SECONDS = 600.0
+
+# Mesh-sharded dispatches must be LAUNCH-ORDERED: two sharded programs
+# in flight over the same device set (two windows from different
+# fingerprint groups, or a window racing a warm-up) interleave their
+# collective rendezvous and deadlock — observed live on the 8-virtual-
+# device CPU backend (AllReduce participants of two run_ids each waiting
+# for all 8 devices), and the same rule governs real multi-chip
+# backends. One module-level lock totally orders sharded fleet
+# launches; single-device dispatches carry no collectives and never
+# take it.
+_MESH_DISPATCH_LOCK = threading.Lock()
+
+
+def _mesh_active(B: int) -> bool:
+    """Whether shard_lanes would place a B-lane batch over the mesh —
+    the condition under which dispatches must serialize."""
+    import jax
+
+    n = len(jax.devices())
+    return n > 1 and B % n == 0
+
+
+# ---------------------------------------------------------------------------
+# the shared lane-stack / dispatch core (dryrun phase 4 + the coalescer)
+
+
+def stack_lanes(st_list, xs_list):
+    """Stack per-lane State/PodX pytrees onto a leading fleet axis.
+    Lanes must be shape-compatible (same table fingerprint + claim-slot
+    rung); the caller owns padding the lane COUNT to its pow-2 bucket."""
+    import jax
+    import jax.numpy as jnp
+
+    st_b = jax.tree_util.tree_map(lambda *a: jnp.stack(a), *st_list)
+    xs_b = jax.tree_util.tree_map(lambda *a: jnp.stack(a), *xs_list)
+    return st_b, xs_b
+
+
+def shard_lanes(st_b, xs_b):
+    """Place stacked lane operands over a `fleet` mesh axis when the
+    backend has multiple devices and the DEVICE COUNT divides the lane
+    bucket (each device gets whole lanes; a B=2 window on 8 devices
+    stays unsharded).
+    Lanes are independent whole solves, so the sharding propagates the
+    batch axis end to end with zero cross-device collectives
+    (dryrun_multichip phase 4's layout); on a single device this is a
+    no-op. Parity is unaffected either way — the mesh only changes
+    placement."""
+    import jax
+
+    devices = jax.devices()
+    B = int(xs_b.valid.shape[0])
+    if not _mesh_active(B):
+        return st_b, xs_b
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices), ("fleet",))
+    lane_sh = NamedSharding(mesh, P("fleet"))
+    return jax.device_put(st_b, lane_sh), jax.device_put(xs_b, lane_sh)
+
+
+_fleet_fn_cache: dict[bool, object] = {}
+
+
+def fleet_fn(relax: bool):
+    """The jitted vmapped solve entry: `vmap(solve_scan, in_axes=(None,
+    0, 0))` — tables shared, State/PodX per lane. Module-level cache per
+    relax flag (a per-call closure would recompile every window); the
+    jit cache then keys on the (B, P, N) bucketed shapes, which the AOT
+    prewarm ladder covers (solver/aot.py fleet combos)."""
+    fn = _fleet_fn_cache.get(relax)
+    if fn is None:
+        import functools
+
+        import jax
+
+        from karpenter_tpu.solver import tpu_kernel as K
+
+        fn = jax.jit(
+            jax.vmap(
+                functools.partial(K.solve_scan, relax=relax),
+                in_axes=(None, 0, 0),
+            )
+        )
+        _fleet_fn_cache[relax] = fn
+    return fn
+
+
+def fleet_dispatch(tb, st_b, xs_b, relax: bool = True):
+    """ONE device dispatch running every stacked lane's solve step
+    batch; returns (st_b, kinds_b, slots_b, over_b) with a leading lane
+    axis (over_b is per lane — solve_scan's any-overflow scalar, mapped).
+    Counted under the existing per-dispatch accounting as path=fleet."""
+    out = fleet_fn(relax)(tb, st_b, xs_b)
+    tracing.SOLVE_DISPATCHES.inc({"path": "fleet"})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the batch-window coalescer
+
+
+class _Lane:
+    """One request's seat in a batch window. Mutated by the leader
+    thread while the owner blocks on `done`; ownership hands back at
+    done.set(), so no field is ever accessed concurrently."""
+
+    __slots__ = (
+        "sched", "problem", "tb", "order", "N", "relax", "deadline",
+        "trace", "done", "result", "error", "entered_at",
+        "st", "kinds", "slots", "pending", "finished", "timed_out",
+        "solo", "rounds", "lanes_in_window",
+    )
+
+    def __init__(self, sched, problem, tb, order, N, relax, deadline, trace):
+        self.sched = sched
+        self.problem = problem
+        self.tb = tb
+        self.order = order
+        self.N = N
+        self.relax = relax
+        self.deadline = deadline
+        self.trace = trace
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.entered_at = time.monotonic()
+        self.st = None
+        self.kinds = None
+        self.slots = None
+        self.pending: list[int] = []
+        self.finished = False
+        self.timed_out = False
+        self.solo = False
+        self.rounds = 0
+        self.lanes_in_window = 1
+
+
+class _Window:
+    """One open batch window for a lane-group key. The FIRST lane in
+    becomes the leader: it waits `window_seconds` (woken early when the
+    window fills), drains the lane list, and drives every lane's rounds
+    through shared dispatches while the others block on their events."""
+
+    def __init__(self, first: _Lane):
+        self.lanes: list[_Lane] = [first]
+        self.full = threading.Event()
+        # set under the coalescer lock when the leader copies the lane
+        # list: a waiter that gives up BEFORE the drain removes itself
+        # (the leader never sees it); after the drain the leader owns
+        # the lane and the waiter must take the handoff, not fork a
+        # duplicate solo solve of the same scheduler
+        self.drained = False
+
+
+class FleetCoalescer:
+    """The batch-window layer in front of scan-path solves.
+
+    Concurrency contract (graftlint race tier): the single lock guards
+    only the open-window map and lane-list membership — never held
+    across a wait, a dispatch, or any jax call, so it is a leaf in the
+    program's lock graph. Leader/waiter handoff rides per-lane Events;
+    the leader sets every lane's result-or-error in a finally, so a
+    waiter can only time out if the leader thread was destroyed
+    mid-solve (then the lane solves solo — degraded, never stuck).
+
+    `window_seconds` is the latency a request trades for siblings; a
+    window that closes with one lane charges only that wait and falls
+    back to the solo path (mode=solo_window). `max_lanes` wakes the
+    leader early when the window fills — the pow-2 lane bucket the AOT
+    ladder covers caps there."""
+
+    def __init__(
+        self,
+        window_seconds: float = 0.02,
+        max_lanes: int = 8,
+        use_mesh: bool = True,
+    ):
+        self.window_seconds = float(window_seconds)
+        self.max_lanes = int(max_lanes)
+        self.use_mesh = use_mesh
+        self._lock = threading.Lock()
+        self._open: dict[tuple, _Window] = {}
+
+    # -- the TpuScheduler hook -------------------------------------------
+
+    def solve_lane(
+        self, sched, problem, tb, order, N: int, relax: bool, deadline, trace
+    ):
+        """Offer one scan-path solve to the current batch window.
+
+        Returns (st, kinds, slots, timed_out) — the same tuple the solo
+        scan loop produces, ready for `TpuScheduler._decode` — or None
+        when the lane must run the solo path instead (no sibling
+        arrived, claim-slot overflow, lane-local or batch-wide failure).
+        Never raises for coalescing-machinery faults: the solo path is
+        always the floor."""
+        lane = _Lane(sched, problem, tb, order, N, relax, deadline, trace)
+        key = (epochs.table_fingerprint(problem), int(N), bool(relax))
+        with tracing.span_of(trace, "fleet_dispatch"):
+            try:
+                result = self._submit(key, lane)
+            except Exception as e:
+                # a batch-wide coalescing fault (stack/dispatch raised in
+                # THIS lane's leader turn) must land on the solo KERNEL
+                # loop, not propagate into HybridScheduler's last-resort
+                # pristine-oracle guard — the request itself is fine,
+                # only the shared dispatch failed (siblings were already
+                # errored to their own solo fallbacks by _submit)
+                lane.error = e
+                result = None
+        wait = time.monotonic() - lane.entered_at
+        FLEET_WINDOW_WAIT.observe(wait)
+        if result is None:
+            mode = "solo_window" if lane.error is None and not lane.solo else "fallback"
+            FLEET_SOLVES.inc({"mode": mode})
+            if lane.error is not None:
+                _log.warn(
+                    "fleet lane fell back to the solo path",
+                    error=f"{type(lane.error).__name__}: {lane.error}",
+                )
+            if trace is not None:
+                trace.event(
+                    "fleet_window", mode=mode, wait_seconds=round(wait, 6)
+                )
+            return None
+        FLEET_SOLVES.inc({"mode": "coalesced"})
+        if trace is not None:
+            trace.event(
+                "fleet_window",
+                mode="coalesced",
+                lanes=lane.lanes_in_window,
+                bucket=buckets.bucket_lanes(lane.lanes_in_window),
+                wait_seconds=round(wait, 6),
+                rounds=lane.rounds,
+            )
+            # rounds can be 0 (a lane whose deadline was blown before
+            # the first shared round): no phantom dispatch on the trace
+            if lane.rounds:
+                trace.count("dispatches", by=lane.rounds)
+        return result
+
+    def _submit(self, key: tuple, lane: _Lane):
+        with self._lock:
+            window = self._open.get(key)
+            if window is not None and len(window.lanes) >= self.max_lanes:
+                # the incumbent window is FULL (its leader is waking to
+                # drain it): never join past max_lanes — a burst bigger
+                # than the lane budget would otherwise swell the bucket
+                # past the prewarmed ladder and compile a fresh vmapped
+                # shape mid-serving. Open a fresh window in the map slot;
+                # the drain-time `is window` check keeps both sound.
+                window = None
+            if window is None:
+                window = _Window(lane)
+                self._open[key] = window
+                leader = True
+            else:
+                window.lanes.append(lane)
+                leader = False
+                if len(window.lanes) >= self.max_lanes:
+                    window.full.set()
+        if not leader:
+            # deadline-shaped first wait: a lane with a short budget
+            # should not sit a full result-cap behind a cold window
+            # (the first coalesced dispatch can compile for tens of
+            # seconds on this backend)
+            budget = _RESULT_WAIT_CAP_SECONDS
+            if lane.deadline is not None:
+                budget = min(
+                    budget,
+                    max(1.0, lane.deadline - time.monotonic())
+                    + self.window_seconds
+                    + 60.0,
+                )
+            if not lane.done.wait(budget):
+                with self._lock:
+                    if not window.drained:
+                        # the leader hasn't taken the lane list yet:
+                        # withdraw cleanly and solve solo — the leader
+                        # will never see this lane
+                        window.lanes.remove(lane)
+                        lane.error = TimeoutError(
+                            "fleet window leader never answered"
+                        )
+                        return None
+                # drained: the leader OWNS this lane (it is already
+                # gathering/dispatching for it) — forking a solo solve
+                # now would run the same scheduler concurrently twice.
+                # Take the handoff under the hard cap; only a leader
+                # thread destroyed un-Pythonically leaves this unset.
+                if not lane.done.wait(_RESULT_WAIT_CAP_SECONDS):
+                    lane.error = TimeoutError(
+                        "fleet window leader never answered"
+                    )
+                    return None
+            if lane.error is not None:
+                return None
+            return lane.result
+        window.full.wait(self.window_seconds)
+        with self._lock:
+            if self._open.get(key) is window:
+                del self._open[key]
+            window.drained = True
+            lanes = list(window.lanes)
+        try:
+            if len(lanes) == 1:
+                return None  # no sibling arrived: solo path, zero extra compile
+            self._run_window(lanes)
+        except BaseException as e:
+            for l in lanes:
+                if l.result is None and l.error is None:
+                    l.error = e if isinstance(e, Exception) else RuntimeError(
+                        f"fleet window aborted: {type(e).__name__}"
+                    )
+            raise
+        finally:
+            for l in lanes:
+                if l is not lane:
+                    l.done.set()
+        if lane.error is not None:
+            return None
+        return lane.result
+
+    # -- the coalesced multi-round solve ---------------------------------
+
+    def _run_window(self, lanes: list[_Lane]) -> None:
+        """Drive every lane's requeue rounds (scheduler.go:380 "schedule
+        again if progress was made") through shared vmapped dispatches.
+        This is the solo scan loop of `TpuScheduler._solve_traced`
+        replicated per lane: same per-round pending sets, same stall
+        rule, same deadline/timeout semantics, same overflow handling
+        (a lane that overflows leaves the batch for the solo loop's
+        N-doubling restart). One compiled shape serves the whole window:
+        the pod axis stays at the window's initial pow-2 rung and
+        finished lanes are backfilled with lane 0, so every round reuses
+        the (B, P, N) program the first dispatch traced."""
+        import jax
+
+        from karpenter_tpu.solver import tpu_kernel as K
+        from karpenter_tpu.solver.tpu_problem import _pow2
+
+        tb = lanes[0].tb
+        relax = lanes[0].relax
+        B_pad = buckets.bucket_lanes(len(lanes))
+        P0 = max(_pow2(len(l.order)) for l in lanes)
+        for l in lanes:
+            l.lanes_in_window = len(lanes)
+        for l in lanes:
+            try:
+                l.st = l.sched._init_state(l.problem, l.N)
+                l.kinds = np.full(len(l.problem.pods), K.KIND_FAIL, np.int32)
+                l.slots = np.full(len(l.problem.pods), -1, np.int32)
+                l.pending = list(l.order)
+            except Exception as e:
+                l.error = e
+                l.finished = True
+        first_round = True
+        while True:
+            now = time.monotonic()
+            for l in lanes:
+                if (
+                    not l.finished
+                    and l.deadline is not None
+                    and now > l.deadline
+                ):
+                    l.timed_out = True
+                    l.finished = True
+            active = [
+                l
+                for l in lanes
+                if not l.finished and not l.solo and l.error is None
+            ]
+            if not active:
+                break
+            # per-lane host work is isolated: a gather failure errors that
+            # lane alone and its siblings keep the round
+            xs_list, st_list, ok = [], [], []
+            for l in active:
+                try:
+                    xs_list.append(self._gather(l, P0))
+                    st_list.append(l.st)
+                    ok.append(l)
+                except Exception as e:
+                    l.error = e
+                    l.finished = True
+            if not ok:
+                continue
+            # backfill to the pow-2 lane bucket with lane 0 (results of
+            # pad lanes are discarded; one compiled shape per window)
+            while len(st_list) < B_pad:
+                st_list.append(st_list[0])
+                xs_list.append(xs_list[0])
+            st_b, xs_b = stack_lanes(st_list, xs_list)
+            sharded = self.use_mesh and _mesh_active(B_pad)
+            if sharded:
+                st_b, xs_b = shard_lanes(st_b, xs_b)
+            # sharded launches are totally ordered (see _MESH_DISPATCH_
+            # LOCK); the device_get rides inside the critical section so
+            # the program has RETIRED before the next sharded launch —
+            # launch order alone does not prevent rendezvous interleaving
+            # on backends that overlap execution
+            with _MESH_DISPATCH_LOCK if sharded else contextlib.nullcontext():
+                st_b, kinds_b, slots_b, over_b = fleet_dispatch(
+                    tb, st_b, xs_b, relax=relax
+                )
+                kinds_b, slots_b, over_b = jax.device_get(
+                    (kinds_b, slots_b, over_b)
+                )
+                if sharded:
+                    # the carried state is consumed NEXT round by another
+                    # sharded launch; materialize it before releasing the
+                    # launch order
+                    st_b = jax.block_until_ready(st_b)
+            if first_round:
+                FLEET_LANES.observe(float(len(ok)))
+                first_round = False
+            for i, l in enumerate(ok):
+                l.rounds += 1
+                l.st = jax.tree_util.tree_map(
+                    lambda a, i=i: a[i], st_b
+                )
+                if bool(over_b[i]):
+                    # scan-path overflow: the solo loop restarts the whole
+                    # solve at 2N — send this lane there; siblings keep
+                    # their committed rounds
+                    l.solo = True
+                    l.finished = True
+                    continue
+                n = len(l.pending)
+                got_kinds = np.asarray(kinds_b[i][:n])
+                got_slots = np.asarray(slots_b[i][:n])
+                batch = np.asarray(l.pending, np.int64)
+                l.kinds[batch] = got_kinds
+                l.slots[batch] = got_slots
+                round_failed = [
+                    p for p, k in zip(l.pending, got_kinds) if k == K.KIND_FAIL
+                ]
+                if not round_failed or len(round_failed) == n:
+                    # all placed, or no progress: stall (queue.go:52)
+                    l.finished = True
+                else:
+                    l.pending = round_failed
+        for l in lanes:
+            if l.error is not None or l.solo:
+                l.result = None
+            else:
+                l.result = (l.st, l.kinds, l.slots, l.timed_out)
+
+    @staticmethod
+    def _gather(l: _Lane, P0: int):
+        """One lane's round PodX at the window's shared pod rung — the
+        SAME `_pod_xs_with_idx` assembly the solo path uses, padded to
+        P0 instead of the lane's own pow-2 so lanes stack (pad positions
+        carry idx 0 and valid=False; the kernel never commits them)."""
+        return l.sched._pod_xs_with_idx(l.problem, l.pending, pad_to=P0)[0]

@@ -102,6 +102,7 @@ class HybridScheduler:
         options: Optional[SchedulerOptions] = None,
         force_oracle: bool = False,
         table_cache=None,
+        fleet=None,
     ):
         self.force_oracle = force_oracle
         self.used_tpu: Optional[bool] = None
@@ -134,6 +135,9 @@ class HybridScheduler:
                 # epochs.DeviceTableCache (optional): a repeat solve of an
                 # identical table encoding skips the per-class uploads
                 table_cache=table_cache,
+                # fleet.FleetCoalescer (optional): scan-path solves join
+                # the server's batch window and share vmapped dispatches
+                fleet=fleet,
             )
             self.oracle = self.tpu.oracle
         self.opts = self.oracle.opts
@@ -299,6 +303,7 @@ def solve_in_process(
     force_oracle: bool = False,
     trace=None,
     table_cache=None,
+    fleet=None,
 ) -> tuple[Results, HybridScheduler]:
     """THE in-process solve assembly: Topology + HybridScheduler, options
     threaded consistently. Every path that solves locally — the
@@ -308,7 +313,9 @@ def solve_in_process(
     `trace` (tracing.Trace) joins the caller's solve trace; a standalone
     call owns a local one. `table_cache` (epochs.DeviceTableCache,
     optional — the sidecar server passes its own) lets repeat solves of
-    an unchanged table encoding skip the per-class device uploads."""
+    an unchanged table encoding skip the per-class device uploads;
+    `fleet` (fleet.FleetCoalescer, optional — likewise server-owned)
+    lets concurrent scan-path solves share one vmapped dispatch."""
     from karpenter_tpu import tracing
 
     with tracing.maybe_trace(trace, "solve") as tr:
@@ -330,6 +337,7 @@ def solve_in_process(
             options,
             force_oracle=force_oracle,
             table_cache=table_cache,
+            fleet=fleet,
         )
         return scheduler.solve(pods, trace=tr), scheduler
 

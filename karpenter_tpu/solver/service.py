@@ -636,6 +636,8 @@ class SolverServer:
         admission: Optional[epochs.AdmissionGate] = None,
         epoch_store: Optional[epochs.EpochStore] = None,
         table_cache: Optional[epochs.DeviceTableCache] = None,
+        fleet_window_seconds: float = 0.0,
+        fleet_max_lanes: int = 8,
     ):
         self.socket_path = socket_path
         self.drain_seconds = drain_seconds
@@ -661,6 +663,23 @@ class SolverServer:
         self.epochs = epoch_store or epochs.EpochStore()
         self.admission = admission or epochs.AdmissionGate()
         self.table_cache = table_cache or epochs.DeviceTableCache()
+        # fleet-axis serving (solver/fleet.py): with a non-zero batch
+        # window, concurrent scan-path solves coalesce onto pow-2 fleet
+        # lanes and share ONE vmapped dispatch per round — the
+        # multi-tenant serving shape dryrun_multichip phase 4 proves.
+        # 0.0 (the default) keeps the stateless per-request dispatch:
+        # a lone control plane should not pay window latency for
+        # siblings that never come. Pair a fleet window with an
+        # AdmissionGate whose max_inflight covers the lane budget —
+        # coalescing WANTS the concurrency the default gate sheds.
+        self.fleet = None
+        if fleet_window_seconds > 0:
+            from karpenter_tpu.solver import fleet as fleet_mod
+
+            self.fleet = fleet_mod.FleetCoalescer(
+                window_seconds=fleet_window_seconds,
+                max_lanes=fleet_max_lanes,
+            )
         # epoch-store writes from handler threads are generation-guarded
         # (under the stats lock, the prewarm-gen discipline): a handler
         # abandoned by stop() must not install sections into a LATER
@@ -721,7 +740,25 @@ class SolverServer:
             else:
                 from karpenter_tpu.solver import aot
 
-                out = aot.prewarm(stop=stop)
+                # a fleet-serving instance also prewarms the vmapped
+                # lane-batched entry — every pow-2 rung up to ITS OWN
+                # lane budget, not a hardcoded ladder — so coalesced
+                # steady state is as zero-compile as the solo path
+                # (docs/serving.md)
+                from karpenter_tpu.solver import buckets as buckets_mod
+
+                fleet_buckets = (
+                    tuple(
+                        buckets_mod.ladder(2, self.fleet.max_lanes, floor=2)
+                    )
+                    if self.fleet is not None
+                    else ()
+                )
+                out = aot.prewarm(
+                    stop=stop,
+                    include_fleet=self.fleet is not None,
+                    fleet_lane_buckets=fleet_buckets,
+                )
                 self.log.info(
                     "prewarm complete",
                     compiled=out["compiled"],
@@ -1139,6 +1176,7 @@ class SolverServer:
             force_oracle=force_oracle,
             trace=tr,
             table_cache=self.table_cache,
+            fleet=self.fleet,
         )
         with self._stats_lock:
             self.solves += 1

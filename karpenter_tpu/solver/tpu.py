@@ -476,6 +476,7 @@ class TpuScheduler:
         daemonset_pods: Optional[list[Pod]] = None,
         options: Optional[SchedulerOptions] = None,
         table_cache=None,
+        fleet=None,
     ):
         # reuse the oracle's init wholesale: template filtering, daemon
         # overhead, existing-node ordering, limits (scheduler.go:116)
@@ -497,6 +498,13 @@ class TpuScheduler:
         # _ktpu_* invalidation invariant extends to these copies because
         # any relax/class-key mutation perturbs the fingerprinted arrays)
         self._table_cache = table_cache
+        # fleet.FleetCoalescer (optional — the sidecar server owns one):
+        # scan-path solves offer themselves to the batch window and ride
+        # a shared vmapped dispatch when siblings arrive; any None answer
+        # (no sibling, overflow, coalescing fault) runs the solo loop
+        # below unchanged
+        self._fleet = fleet
+        self.last_used_fleet = False
 
     # -- solve ----------------------------------------------------------
 
@@ -605,6 +613,28 @@ class TpuScheduler:
         if tiers_beyond_0:
             prof.count("relax_tiers", by=tiers_beyond_0)
             tracing.SOLVE_RELAX_TIERS.inc(by=tiers_beyond_0)
+        # Fleet coalescing (solver/fleet.py): scan-path solves offer
+        # themselves to the batch window; when siblings stack, the whole
+        # requeue-round loop below runs inside ONE shared vmapped dispatch
+        # per round and the lane's (st, kinds, slots, timed_out) comes
+        # back solo-bit-identical. The runs path never coalesces — its
+        # mid-solve claim regrow is host-driven per lane — and any None
+        # answer (no sibling arrived, claim overflow, coalescing fault)
+        # falls through to the unchanged solo loop.
+        self.last_used_fleet = False
+        if self._fleet is not None and not use_runs:
+            got = self._fleet.solve_lane(
+                self, problem, tb, order, N, relax, deadline, prof
+            )
+            if got is not None:
+                st, kinds, slots, timed_out = got
+                self.last_used_fleet = True
+                prof.annotate(
+                    pods=len(pods), path="fleet", relax=relax,
+                    claim_slots=N, timed_out=timed_out,
+                )
+                with prof.span("decode"):
+                    return self._decode(problem, st, kinds, slots, timed_out)
         while True:
             st = self._init_state(problem, N)
             seq = jax.numpy.zeros(N, jax.numpy.int32)
@@ -1089,14 +1119,19 @@ class TpuScheduler:
         ).any(axis=1)
         self._aff_c = aff_c
 
-    def _pod_xs_with_idx(self, p: EncodedProblem, indices: list[int]):
+    def _pod_xs_with_idx(
+        self, p: EncodedProblem, indices: list[int], pad_to: int = 0
+    ):
         """(PodX, idx_d, n_d) — the run-driver arrays (_run_x) derive from
         the same uploaded index array, so callers thread it through rather
-        than paying a second [P] upload."""
+        than paying a second [P] upload. `pad_to` overrides the pod-axis
+        pad (>= the own pow-2 rung): fleet windows pad every lane to the
+        window's shared rung so lanes stack (solver/fleet.py); pad
+        positions carry idx 0 and valid=False either way."""
         import jax.numpy as jnp
 
         n = len(indices)
-        P_pad = _pow2(n)
+        P_pad = max(_pow2(n), pad_to)
         dt = np.uint16 if len(p.pods) < 65536 else np.int32
         idx = np.zeros(P_pad, dtype=dt)
         idx[:n] = np.asarray(indices, dtype=dt)

@@ -216,6 +216,39 @@ def make_topology_spread_pods(count: int, key: str) -> list[Pod]:
     ]
 
 
+def make_self_spread_pods(count: int, cpu: str = "100m") -> list[Pod]:
+    """Self-selecting zone-spread pods: every pod carries a DO_NOT_
+    SCHEDULE zone spread whose selector matches its own (shared) labels.
+    This is the dynamic-topology shape that forces the exact per-pod
+    SCAN path (tpu.py _bulk_class_flags: self-selecting zone-family
+    spread counts move mid-run), which is the only path the fleet
+    coalescer serves — the ONE fixture behind tests/test_fleet.py,
+    the fault suite's fleet lanes, analysis/ir.py's fleet[runtime]
+    kit, and bench.py --fleet, so what forces the scan path is defined
+    in exactly one place. `cpu` varies the request profile per lane
+    WITHOUT touching the requirement classes (keep it a multiple of
+    100m: request granularity feeds the resource-table scale, which is
+    shared-Tables content the fleet fingerprint correctly refuses to
+    stack across)."""
+    labels = {"app": "fleet"}
+    return [
+        pod(
+            name=f"sp-{i}",
+            labels=dict(labels),
+            requests={"cpu": cpu},
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=well_known.TOPOLOGY_ZONE_LABEL_KEY,
+                    when_unsatisfiable=WhenUnsatisfiable.DO_NOT_SCHEDULE,
+                    label_selector=LabelSelector(match_labels=dict(labels)),
+                )
+            ],
+        )
+        for i in range(count)
+    ]
+
+
 def make_pod_affinity_pods(count: int, key: str) -> list[Pod]:
     out = []
     for i in range(count):

@@ -65,7 +65,8 @@ SOLVE_PHASE_SECONDS = metrics.REGISTRY.histogram(
 )
 SOLVE_DISPATCHES = metrics.REGISTRY.counter(
     "karpenter_solve_dispatches_total",
-    "Device kernel dispatches, by path (runs/scan/sweep/setsweep).",
+    "Device kernel dispatches, by path (runs/scan/sweep/setsweep, plus "
+    "fleet = one coalesced vmapped dispatch per batch-window round).",
     ("path",),
 )
 SOLVE_REGROWS = metrics.REGISTRY.counter(

@@ -1,0 +1,309 @@
+"""Fleet-axis serving conformance (solver/fleet.py).
+
+The acceptance pins for the coalescing subsystem:
+
+- the PARITY MATRIX: N in {2, 5, 8} concurrent sidecar solves with
+  distinct request profiles coalesce into ONE vmapped dispatch (asserted
+  via the per-dispatch accounting and the traces' fleet_dispatch spans)
+  and EVERY lane's NodeClaims are identical to its solo in-process
+  solve — decisions, instance-type survivor sets, and request vectors;
+- the shared lane-stack/dispatch core is bit-identical to per-lane
+  solo `solve_scan` runs (the in-tree twin of dryrun_multichip phase 4,
+  which now drives the same fleet.py code);
+- a window that closes with one lane falls back to the solo path with
+  identical decisions (mode=solo_window — the coalescer never taxes a
+  lone control plane with a compiled vmapped shape);
+- runs-path solves never enter the coalescer (mid-solve claim regrow is
+  host-driven per lane) and still answer identically;
+- the grouping key (epochs.table_fingerprint) admits distinct request
+  profiles while refusing different clusters.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import tracing
+from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+from karpenter_tpu.solver import epochs, fleet
+from karpenter_tpu.solver.service import SolverClient, SolverServer
+from karpenter_tpu.solver.topology import Topology
+from karpenter_tpu.solver.tpu import TpuScheduler
+from karpenter_tpu.solver.tpu_problem import encode_problem
+from karpenter_tpu.testing import fixtures
+
+# the client-side wire budget: the FIRST coalesced window compiles the
+# vmapped kernel cold on this CPU backend, and every sibling lane waits
+# behind that compile inside its own solve call
+WIRE_TIMEOUT = 600.0
+
+
+def _spread_pods(n: int, cpu: str) -> list:
+    """The shared scan-path fixture (fixtures.make_self_spread_pods):
+    `cpu` varies the request profile per lane WITHOUT touching the
+    requirement classes, so distinct profiles still share one table
+    fingerprint (the phase-4 shape)."""
+    return fixtures.make_self_spread_pods(n, cpu)
+
+
+def _problem(cpu: str, n: int = 6):
+    fixtures.reset_rng(5)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    pods = _spread_pods(n, cpu)
+    return pools, {"default": its}, pods
+
+
+def _solo_parts(cpu: str, n: int = 6):
+    """The solo in-process referee: the same problem through a fresh
+    TpuScheduler (no fleet, no cache)."""
+    pools, ibp, pods = _problem(cpu, n)
+    topo = Topology(pools, ibp, pods)
+    sched = TpuScheduler(pools, ibp, topo)
+    r = sched.solve(pods)
+    assert not sched.last_used_runs, "referee must ride the scan path"
+    assert not r.pod_errors, r.pod_errors
+    return sorted(
+        (
+            tuple(sorted(p.name for p in c.pods)),
+            c.template.nodepool_name,
+            tuple(sorted(it.name for it in c.instance_type_options)),
+            tuple(sorted(c.requests.items())),
+        )
+        for c in r.new_node_claims
+        if c.pods
+    )
+
+
+def _remote_parts(got: dict, pods) -> list:
+    name_of = {p.uid: p.name for p in pods}
+    return sorted(
+        (
+            tuple(sorted(name_of[u] for u in cl["pod_uids"])),
+            cl["nodepool"],
+            tuple(sorted(cl["instance_types"])),
+            tuple(sorted((k, int(v)) for k, v in cl["requests"].items())),
+        )
+        for cl in got["new_node_claims"]
+        if cl["pod_uids"]
+    )
+
+
+# all multiples of 100m: request granularity feeds the resource-table
+# scale, and a profile that changes the scale (e.g. 150m) changes the
+# integer ialloc/icap encodings — a REAL tb difference the table
+# fingerprint correctly refuses to stack
+# (test_table_fingerprint_groups_profiles_not_clusters pins the refusal
+# side on a cluster change)
+_PROFILES = [f"{k}00m" for k in range(1, 9)]
+
+
+@pytest.mark.parametrize("lanes", [2, 5, 8])
+def test_fleet_parity_matrix(lanes):
+    """The acceptance matrix: `lanes` concurrent sidecar solves with
+    distinct request profiles coalesce into ONE vmapped dispatch and
+    every lane's claims equal its solo in-process solve."""
+    profiles = _PROFILES[:lanes]
+    refs = {cpu: _solo_parts(cpu) for cpu in profiles}
+
+    path = tempfile.mktemp(suffix=".fleet.sock")
+    srv = SolverServer(
+        path,
+        # generous: per-lane server-side encode is GIL-serialized on this
+        # 1-core box, so the last of 8 lanes can trail the first by
+        # seconds — a FULL window still wakes the leader immediately, so
+        # the happy path never waits this long
+        fleet_window_seconds=10.0,
+        fleet_max_lanes=lanes,
+        admission=epochs.AdmissionGate(max_inflight=32),
+    )
+    srv.start()
+    d0 = tracing.SOLVE_DISPATCHES.value({"path": "fleet"})
+    c0 = fleet.FLEET_SOLVES.value({"mode": "coalesced"})
+    seq0 = tracing.Trace("probe").seq  # ring watermark for new traces
+    out: dict[str, tuple] = {}
+    errors: dict[str, BaseException] = {}
+    barrier = threading.Barrier(lanes)
+
+    def client(cpu: str) -> None:
+        try:
+            c = SolverClient(path, request_timeout=WIRE_TIMEOUT)
+            pools, ibp, pods = _problem(cpu)
+            barrier.wait()
+            got = c.solve(pools, ibp, pods)
+            out[cpu] = (got, _remote_parts(got, pods))
+            c.close()
+        except BaseException as e:
+            errors[cpu] = e
+
+    try:
+        threads = [
+            threading.Thread(target=client, args=(cpu,), daemon=True)
+            for cpu in profiles
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=WIRE_TIMEOUT)
+    finally:
+        srv.stop()
+    assert not errors, errors
+    for cpu in profiles:
+        got, parts = out[cpu]
+        assert got["used_tpu"], cpu
+        assert not got["pod_errors"], (cpu, got["pod_errors"])
+        assert parts == refs[cpu], cpu
+    # every lane counted as coalesced, and no lane fell back to a solo
+    # scan dispatch
+    assert fleet.FLEET_SOLVES.value({"mode": "coalesced"}) - c0 == lanes
+    # the per-dispatch span accounting (PR 8): every server-side trace of
+    # the window carries the shared fleet_dispatch span + window event
+    # reporting ALL `lanes` lanes in ONE window; the global fleet dispatch
+    # count equals the window's (shared) requeue-round count — one
+    # vmapped dispatch per round for the WHOLE window, never per lane
+    new_server_traces = [
+        t
+        for t in tracing.RING.snapshot()
+        if t.seq > seq0 and t.side == "server"
+    ]
+    assert len(new_server_traces) == lanes
+    rounds = set()
+    for t in new_server_traces:
+        names = {s.name for s in t.spans}
+        assert "fleet_dispatch" in names and "fleet_window" in names
+        win = next(s for s in t.spans if s.name == "fleet_window")
+        assert win.attrs.get("mode") == "coalesced"
+        assert win.attrs.get("lanes") == lanes
+        rounds.add(t.counts.get("dispatches"))
+    assert tracing.SOLVE_DISPATCHES.value({"path": "fleet"}) - d0 == max(
+        rounds
+    )
+
+
+def test_fleet_core_matches_solo_solve_scan():
+    """The shared lane-stack/dispatch core (the code dryrun_multichip
+    phase 4 now drives) is bit-identical per lane to solo solve_scan —
+    the in-tree twin of the driver's fleet phase."""
+    import jax
+
+    import __graft_entry__ as ge
+    from karpenter_tpu.solver import tpu_kernel as K
+
+    tb, st, xs, _, _ = ge._small_problem(n_pods=16)
+    B = 4
+    scale = (1 + (np.arange(B) % 3)).astype(np.int32)
+    xs_lanes = [
+        xs._replace(prequests=xs.prequests * int(scale[k])) for k in range(B)
+    ]
+    refs = []
+    for k in range(B):
+        st_k, kinds_k, slots_k, _ = jax.jit(K.solve_scan)(tb, st, xs_lanes[k])
+        refs.append(
+            (
+                int(st_k.n_claims),
+                np.asarray(kinds_k).copy(),
+                np.asarray(slots_k).copy(),
+            )
+        )
+    st_b, xs_b = fleet.stack_lanes([st] * B, xs_lanes)
+    st_b, xs_b = fleet.shard_lanes(st_b, xs_b)
+    st_f, kinds_f, slots_f, _ = fleet.fleet_dispatch(tb, st_b, xs_b)
+    kinds_f = np.asarray(kinds_f)
+    slots_f = np.asarray(slots_f)
+    n_claims_f = np.asarray(st_f.n_claims)
+    for k, (n_ref, kinds_ref, slots_ref) in enumerate(refs):
+        assert int(n_claims_f[k]) == n_ref, k
+        assert np.array_equal(kinds_f[k], kinds_ref), k
+        assert np.array_equal(slots_f[k], slots_ref), k
+
+
+def test_single_lane_window_falls_back_solo():
+    """A window that closes with one lane must charge only the wait:
+    the lane runs the existing solo path (no vmapped compile for B=1)
+    with identical decisions, counted as mode=solo_window."""
+    ref = _solo_parts("100m")
+    s0 = fleet.FLEET_SOLVES.value({"mode": "solo_window"})
+    coalescer = fleet.FleetCoalescer(window_seconds=0.05, max_lanes=8)
+    pools, ibp, pods = _problem("100m")
+    topo = Topology(pools, ibp, pods)
+    sched = TpuScheduler(pools, ibp, topo, fleet=coalescer)
+    r = sched.solve(pods)
+    assert not sched.last_used_fleet
+    got = sorted(
+        (
+            tuple(sorted(p.name for p in c.pods)),
+            c.template.nodepool_name,
+            tuple(sorted(it.name for it in c.instance_type_options)),
+            tuple(sorted(c.requests.items())),
+        )
+        for c in r.new_node_claims
+        if c.pods
+    )
+    assert got == ref
+    assert fleet.FLEET_SOLVES.value({"mode": "solo_window"}) - s0 == 1
+
+
+def test_runs_path_never_enters_the_coalescer():
+    """Bulkable (runs-path) solves are ineligible — mid-solve claim
+    regrow is host-driven per lane — and must solve identically with a
+    coalescer configured, without touching the window."""
+    fixtures.reset_rng(9)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    pods = fixtures.make_generic_pods(8)
+
+    def solve(coalescer):
+        fixtures.reset_rng(9)
+        its2 = construct_instance_types(sizes=[2, 8])
+        pools2 = [fixtures.node_pool(name="default")]
+        pods2 = fixtures.make_generic_pods(8)
+        topo = Topology(pools2, {"default": its2}, pods2)
+        sched = TpuScheduler(pools2, {"default": its2}, topo, fleet=coalescer)
+        r = sched.solve(pods2)
+        return sched, sorted(
+            tuple(sorted(p.name for p in c.pods))
+            for c in r.new_node_claims
+            if c.pods
+        )
+
+    _, ref = solve(None)
+    before = {
+        m: fleet.FLEET_SOLVES.value({"mode": m})
+        for m in ("coalesced", "solo_window", "fallback")
+    }
+    sched, got = solve(fleet.FleetCoalescer(window_seconds=5.0))
+    assert sched.last_used_runs and not sched.last_used_fleet
+    assert got == ref
+    for m, v in before.items():
+        assert fleet.FLEET_SOLVES.value({"mode": m}) == v, m
+
+
+def test_table_fingerprint_groups_profiles_not_clusters():
+    """The grouping key: distinct request profiles (different request
+    vectors, same requirement classes) share a table fingerprint — they
+    can stack — while a different cluster (an extra instance-type size)
+    never does."""
+
+    def fp(cpu: str, sizes=(2, 8)):
+        fixtures.reset_rng(5)
+        its = construct_instance_types(sizes=list(sizes))
+        pools = [fixtures.node_pool(name="default")]
+        pods = _spread_pods(6, cpu)
+        topo = Topology(pools, {"default": its}, pods)
+        sched = TpuScheduler(pools, {"default": its}, topo)
+        problem = encode_problem(sched.oracle, pods)
+        return (
+            epochs.table_fingerprint(problem),
+            epochs.problem_fingerprint(problem),
+        )
+
+    t1, p1 = fp("100m")
+    t2, p2 = fp("300m")
+    t3, _ = fp("100m", sizes=(2, 8, 32))
+    assert t1 == t2, "distinct request profiles must share a table key"
+    assert p1 != p2, "the full problem fingerprint must still differ"
+    assert t1 != t3, "a different cluster must never share a table key"

@@ -1523,3 +1523,267 @@ def test_drain_closes_connection_after_any_answered_frame(server, monkeypatch):
     t.join(timeout=30)
     stopper.join(timeout=30)
     a.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet-axis lane isolation (solver/fleet.py): one lane's fault — corrupt
+# frame, oversized frame, blown deadline, vanished client — must never
+# poison its window siblings, whose decisions stay identical to solo
+
+
+def _fleet_problem(cpu):
+    """One fleet lane: the shared scan-path fixture
+    (fixtures.make_self_spread_pods); `cpu` varies the request profile
+    without changing the table fingerprint (tests/test_fleet.py)."""
+    fixtures.reset_rng(5)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    return pools, {"default": its}, fixtures.make_self_spread_pods(6, cpu)
+
+
+def _fleet_referee(cpu):
+    """Solo in-process kernel solve of the same lane problem."""
+    from karpenter_tpu.solver.tpu import TpuScheduler
+
+    pools, ibp, pods = _fleet_problem(cpu)
+    topo = Topology(pools, ibp, pods)
+    r = TpuScheduler(pools, ibp, topo).solve(pods)
+    return sorted(
+        tuple(sorted(p.name for p in cl.pods))
+        for cl in r.new_node_claims
+        if cl.pods
+    )
+
+
+def _fleet_server(max_lanes, window=10.0):
+    from karpenter_tpu.solver import epochs as epochs_mod
+
+    path = tempfile.mktemp(suffix=".fleet.sock")
+    srv = SolverServer(
+        path,
+        fleet_window_seconds=window,
+        fleet_max_lanes=max_lanes,
+        admission=epochs_mod.AdmissionGate(max_inflight=32),
+    )
+    srv.start()
+    return srv
+
+
+def _fleet_clients(srv, profiles, options_of=None, results=None, errors=None):
+    """Concurrent sidecar solves, one thread per profile; returns
+    (results, errors) keyed by profile."""
+    results = {} if results is None else results
+    errors = {} if errors is None else errors
+    barrier = threading.Barrier(len(profiles))
+
+    def run(cpu):
+        try:
+            c = SolverClient(srv.socket_path, request_timeout=600.0)
+            pools, ibp, pods = _fleet_problem(cpu)
+            opts = options_of(cpu) if options_of else None
+            barrier.wait()
+            got = c.solve(pools, ibp, pods, options=opts)
+            results[cpu] = (got, pods)
+            c.close()
+        except Exception as e:
+            errors[cpu] = e
+
+    threads = [
+        threading.Thread(target=run, args=(cpu,), daemon=True)
+        for cpu in profiles
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    return results, errors
+
+
+def _fleet_remote_parts(got, pods):
+    name_of = {p.uid: p.name for p in pods}
+    return sorted(
+        tuple(sorted(name_of[u] for u in cl["pod_uids"]))
+        for cl in got["new_node_claims"]
+        if cl["pod_uids"]
+    )
+
+
+def test_fleet_deadline_blown_lane_does_not_poison_siblings():
+    """A lane whose solve budget is already exhausted when the window
+    drains must come back timed_out with no decisions — exactly the solo
+    partial-result contract — while its three siblings land
+    decision-identical to solo in the SAME coalesced window."""
+    healthy = ["100m", "200m", "300m"]
+    refs = {cpu: _fleet_referee(cpu) for cpu in healthy}
+    srv = _fleet_server(max_lanes=4)
+    try:
+        results, errors = _fleet_clients(
+            srv,
+            healthy + ["400m"],
+            options_of=lambda cpu: (
+                SchedulerOptions(timeout_seconds=1e-9)
+                if cpu == "400m"
+                else None
+            ),
+        )
+    finally:
+        srv.stop()
+    assert not errors, errors
+    got, _pods = results["400m"]
+    assert got["timed_out"] is True
+    assert not got["new_node_claims"] or not any(
+        cl["pod_uids"] for cl in got["new_node_claims"]
+    )
+    for cpu in healthy:
+        got, pods = results[cpu]
+        assert got["timed_out"] is False
+        assert not got["pod_errors"]
+        assert _fleet_remote_parts(got, pods) == refs[cpu], cpu
+
+
+def test_fleet_corrupt_and_oversized_lanes_do_not_poison_the_window(
+    monkeypatch,
+):
+    """Corrupt and oversized frames arriving alongside a coalescing
+    window cost THEIR senders one ERROR answer each — the siblings'
+    coalesced window never sees them and lands decision-identical to
+    solo."""
+    from karpenter_tpu.solver import fleet as fleet_mod
+    from karpenter_tpu.solver import service as svc
+
+    # above the real ~130 KB lane payloads, far below the production cap
+    monkeypatch.setattr(svc, "MAX_FRAME_LEN", 512 * 1024)
+    monkeypatch.setattr(svc, "OVERSIZE_DRAIN_MAX", 2 * 1024 * 1024)
+    healthy = ["100m", "200m", "300m"]
+    refs = {cpu: _fleet_referee(cpu) for cpu in healthy}
+    srv = _fleet_server(max_lanes=3)
+    c0 = fleet_mod.FLEET_SOLVES.value({"mode": "coalesced"})
+    try:
+        # the faulty traffic rides raw sockets concurrently with the
+        # window: garbage JSON on a valid frame + an oversized frame
+        def corrupt():
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(30)
+            sock.connect(srv.socket_path)
+            body = b"this is not a problem payload"
+            sock.sendall(
+                MAGIC + struct.pack("<III", KIND_SOLVE, 21, len(body)) + body
+            )
+            head = _read_exact(sock, 16)
+            kind, rid, length = struct.unpack("<III", head[4:])
+            _read_exact(sock, length)
+            assert (kind, rid) == (KIND_ERROR, 21)
+            sock.close()
+
+        def oversized():
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(30)
+            sock.connect(srv.socket_path)
+            body = b"x" * (1024 * 1024)  # over MAX, under the drain cap
+            sock.sendall(
+                MAGIC + struct.pack("<III", KIND_SOLVE, 22, len(body)) + body
+            )
+            head = _read_exact(sock, 16)
+            kind, rid, length = struct.unpack("<III", head[4:])
+            payload = _read_exact(sock, length)
+            assert (kind, rid) == (KIND_ERROR, 22)
+            assert b"exceeds max" in payload
+            sock.close()
+
+        fault_threads = [
+            threading.Thread(target=corrupt, daemon=True),
+            threading.Thread(target=oversized, daemon=True),
+        ]
+        for t in fault_threads:
+            t.start()
+        results, errors = _fleet_clients(srv, healthy)
+        for t in fault_threads:
+            t.join(timeout=60)
+    finally:
+        srv.stop()
+    assert not errors, errors
+    for cpu in healthy:
+        got, pods = results[cpu]
+        assert not got["pod_errors"]
+        assert _fleet_remote_parts(got, pods) == refs[cpu], cpu
+    # the healthy lanes really shared a window despite the fault traffic
+    assert fleet_mod.FLEET_SOLVES.value({"mode": "coalesced"}) - c0 == 3
+
+
+@pytest.mark.soak
+def test_chaos_soak_fleet_rotating_lane_faults(monkeypatch):
+    """Steady coalesced traffic with a rotating per-lane fault — corrupt
+    frame, blown deadline, client vanishing mid-solve, oversized frame —
+    one faulty lane per round against three healthy siblings. Every
+    round, every healthy lane must land decision-identical to the solo
+    referee (runs under racert-instrumented locks via the soak marker:
+    the coalescer's window lock and event handoffs are witnessed too)."""
+    from karpenter_tpu.solver import service as svc
+
+    # above the real ~130 KB lane payloads, far below the production cap
+    monkeypatch.setattr(svc, "MAX_FRAME_LEN", 512 * 1024)
+    monkeypatch.setattr(svc, "OVERSIZE_DRAIN_MAX", 2 * 1024 * 1024)
+    healthy = ["100m", "200m", "300m"]
+    refs = {cpu: _fleet_referee(cpu) for cpu in healthy}
+    srv = _fleet_server(max_lanes=4, window=2.0)
+    try:
+        for round_i, fault in enumerate(
+            ["corrupt", "deadline", "vanish", "oversized"]
+        ):
+            results, errors = {}, {}
+
+            def faulty():
+                try:
+                    if fault == "deadline":
+                        c = SolverClient(srv.socket_path, request_timeout=600.0)
+                        pools, ibp, pods = _fleet_problem("400m")
+                        got = c.solve(
+                            pools, ibp, pods,
+                            options=SchedulerOptions(timeout_seconds=1e-9),
+                        )
+                        assert got["timed_out"] is True
+                        c.close()
+                        return
+                    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    sock.settimeout(30)
+                    sock.connect(srv.socket_path)
+                    if fault == "corrupt":
+                        body = b"{not json"
+                    elif fault == "oversized":
+                        body = b"x" * (1024 * 1024)
+                    else:  # vanish: a real-looking frame, then hang up
+                        body = b"{}"
+                    sock.sendall(
+                        MAGIC
+                        + struct.pack("<III", KIND_SOLVE, 31, len(body))
+                        + body
+                    )
+                    if fault == "vanish":
+                        sock.close()
+                        return
+                    head = _read_exact(sock, 16)
+                    kind, rid, length = struct.unpack("<III", head[4:])
+                    _read_exact(sock, length)
+                    assert kind == KIND_ERROR
+                    sock.close()
+                except Exception as e:  # surfaced via errors dict below
+                    errors["faulty"] = e
+
+            ft = threading.Thread(target=faulty, daemon=True)
+            ft.start()
+            _fleet_clients(srv, healthy, results=results, errors=errors)
+            ft.join(timeout=120)
+            assert not ft.is_alive(), f"round {round_i}: faulty lane wedged"
+            faulty_err = errors.pop("faulty", None)
+            assert faulty_err is None, (round_i, fault, faulty_err)
+            assert not errors, (round_i, fault, errors)
+            for cpu in healthy:
+                got, pods = results[cpu]
+                assert not got["pod_errors"], (round_i, fault)
+                assert _fleet_remote_parts(got, pods) == refs[cpu], (
+                    round_i,
+                    fault,
+                    cpu,
+                )
+    finally:
+        srv.stop()
