@@ -745,8 +745,11 @@ def fleet_runtime_metrics() -> dict[str, int]:
     one FleetCoalescer + shared DeviceTableCache — exactly how a
     fleet-serving SolverServer stacks sibling solves:
 
-    - the FIRST window may upload tables per lane (a cache-miss race is
-      legal: both lanes can encode before either's put lands), ceiling 2;
+    - the FIRST window materializes the shared `Tables` pytree exactly
+      ONCE: the cache's table-level single-flight
+      (epochs.DeviceTableCache.begin_tables) elects one builder per
+      table fingerprint, closing the old both-lanes-encode-before-
+      either-put race (the budget's former ceiling of 2);
     - a REPEAT window of the same table encoding uploads exactly ZERO
       per-class tables (every lane hits the server's resident cache —
       one materialization serves the whole window),

@@ -70,6 +70,29 @@ _ENUMS = {
     )
 }
 
+# Every api enum subclasses str, so to_jsonable's primitive fast path
+# serializes them as their BARE VALUE (compact, and exactly what the C++
+# client emits — the `__enum__` envelope below only matters for plain
+# Enums). A bare value decodes as `str`, which compares EQUAL to its
+# str-enum member — so every requirement/taint/phase comparison works —
+# but `.value` accesses crash (`taint.effect.value` in an error-message
+# path was the differential fuzzer's find, corpus pin seed8505). Coerce
+# the known enum-typed dataclass fields back to members at decode; the
+# wire bytes are unchanged, so pre-fix senders round-trip identically.
+_ENUM_FIELDS: dict[str, dict[str, type]] = {
+    "NodeSelectorRequirement": {"operator": api.Operator},
+    "LabelSelectorRequirement": {"operator": api.Operator},
+    "Taint": {"effect": api.TaintEffect},
+    "Toleration": {"effect": api.TaintEffect},
+    "TopologySpreadConstraint": {
+        "when_unsatisfiable": api.WhenUnsatisfiable,
+        "node_affinity_policy": api.NodeInclusionPolicy,
+        "node_taints_policy": api.NodeInclusionPolicy,
+    },
+    "Pod": {"phase": api.PodPhase},
+    "Disruption": {"consolidation_policy": api.ConsolidationPolicy},
+}
+
 
 def to_jsonable(obj: Any) -> Any:
     if obj is None or isinstance(obj, (bool, int, float, str)):
@@ -154,6 +177,10 @@ def from_jsonable(data: Any) -> Any:
                 for k, v in data.items()
                 if k != "__type__"
             }
+            for k, enum_cls in _ENUM_FIELDS.get(tname, {}).items():
+                v = kwargs.get(k)
+                if isinstance(v, str) and not isinstance(v, enum.Enum):
+                    kwargs[k] = enum_cls(v)
             return cls(**kwargs)
         return {k: from_jsonable(v) for k, v in data.items()}
     raise TypeError(f"cannot deserialize {type(data).__name__}")

@@ -499,12 +499,36 @@ class DeviceTableCache:
     are immutable, so entries are safely shared by concurrent solves;
     capacity bounds the HBM resident entries can pin. Invalidation is
     structural: a changed encoding changes the fingerprint, so stale
-    entries are unreachable and age out of the LRU."""
+    entries are unreachable and age out of the LRU.
+
+    Two levels (ROADMAP item 3 leftover, closed by the fleet pairing):
+
+    - the FULL entry, keyed by `problem_fingerprint` — a hit skips every
+      upload (the epoch[runtime] zero);
+    - the SHARED-TABLES entry (`get_tables`/`put_tables`), keyed by
+      `table_fingerprint` — the `Tables` pytree is a pure function of
+      the table-hashed fields (solver/fleet.py's stacking precondition),
+      so coalesced same-epoch solves whose PENDING-POD batches differ
+      (different problem fingerprints, one cluster epoch) still share
+      ONE tb materialization and rebuild only their per-lane pod tables.
+
+    Builds are SINGLE-FLIGHT per table fingerprint (`begin_tables` /
+    `end_tables`): concurrent misses — the fleet window's lanes all
+    encoding before any put lands — elect one builder, the rest wait on
+    its per-key event and take the resident tb. The wait is bounded and
+    failure-safe: a builder that dies publishes None and the waiter
+    builds its own copy (degraded, never wrong or stuck)."""
+
+    # a waiting lane outlasting this means the builder thread died
+    # un-Pythonically mid-upload; waiters then build their own copy
+    BUILD_WAIT_SECONDS = 600.0
 
     def __init__(self, capacity: int = 8):
         self.capacity = capacity
         self._lock = threading.Lock()
         self._items: "OrderedDict[str, tuple]" = OrderedDict()
+        self._tables: "OrderedDict[str, Any]" = OrderedDict()
+        self._building: dict[str, threading.Event] = {}
 
     def get(self, key: str):
         with self._lock:
@@ -521,6 +545,74 @@ class DeviceTableCache:
             while len(self._items) > self.capacity:
                 self._items.popitem(last=False)
 
+    def get_tables(self, table_key: str):
+        """The resident shared `Tables` pytree for a table fingerprint,
+        or None. Counted as its own outcome so the serving telemetry can
+        tell a tb-share (per-lane pod tables still upload) from a full
+        hit."""
+        with self._lock:
+            tb = self._tables.get(table_key)
+            if tb is not None:
+                self._tables.move_to_end(table_key)
+        if tb is not None:
+            TABLE_CACHE.inc({"outcome": "tables_hit"})
+        return tb
+
+    def put_tables(self, table_key: str, tb) -> None:
+        with self._lock:
+            self._tables[table_key] = tb
+            self._tables.move_to_end(table_key)
+            while len(self._tables) > self.capacity:
+                self._tables.popitem(last=False)
+
+    def begin_tables(self, table_key: str):
+        """Single-flight election for one tb materialization. Returns
+        (tb, None) when the tables are already resident, else
+        (None, token): a truthy token means THIS caller builds (and must
+        end_tables in a finally); None means a sibling built while we
+        waited — re-check get_tables, and on a publish failure build
+        anyway. The event wait happens OUTSIDE the lock (leaf-lock
+        contract, graftlint race tier)."""
+        while True:
+            with self._lock:
+                tb = self._tables.get(table_key)
+                if tb is not None:
+                    self._tables.move_to_end(table_key)
+                else:
+                    ev = self._building.get(table_key)
+                    if ev is None:
+                        self._building[table_key] = threading.Event()
+                        return None, table_key
+            if tb is not None:
+                TABLE_CACHE.inc({"outcome": "tables_hit"})
+                return tb, None
+            if not ev.wait(self.BUILD_WAIT_SECONDS):
+                # builder thread destroyed mid-upload: evict the stale
+                # election (if it is still ours) so the KEY recovers —
+                # later solves elect a fresh builder instead of each
+                # stalling the full wait — wake fellow waiters, and
+                # build our own copy
+                with self._lock:
+                    if self._building.get(table_key) is ev:
+                        del self._building[table_key]
+                ev.set()
+                return None, None
+
+    def end_tables(self, token, tb) -> None:
+        """Publish a single-flight build (tb=None on failure: waiters are
+        woken and fall back to building their own copies)."""
+        if token is None:
+            return
+        with self._lock:
+            if tb is not None:
+                self._tables[token] = tb
+                self._tables.move_to_end(token)
+                while len(self._tables) > self.capacity:
+                    self._tables.popitem(last=False)
+            ev = self._building.pop(token, None)
+        if ev is not None:
+            ev.set()
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._items)
@@ -528,6 +620,7 @@ class DeviceTableCache:
     def clear(self) -> None:
         with self._lock:
             self._items.clear()
+            self._tables.clear()
 
 
 # ---------------------------------------------------------------------------

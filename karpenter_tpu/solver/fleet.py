@@ -208,7 +208,7 @@ class _Lane:
         "sched", "problem", "tb", "order", "N", "relax", "deadline",
         "trace", "done", "result", "error", "entered_at",
         "st", "kinds", "slots", "pending", "finished", "timed_out",
-        "solo", "rounds", "lanes_in_window",
+        "solo", "rounds", "lanes_in_window", "epoch_key",
     )
 
     def __init__(self, sched, problem, tb, order, N, relax, deadline, trace):
@@ -233,6 +233,7 @@ class _Lane:
         self.solo = False
         self.rounds = 0
         self.lanes_in_window = 1
+        self.epoch_key = None
 
 
 class _Window:
@@ -284,7 +285,8 @@ class FleetCoalescer:
     # -- the TpuScheduler hook -------------------------------------------
 
     def solve_lane(
-        self, sched, problem, tb, order, N: int, relax: bool, deadline, trace
+        self, sched, problem, tb, order, N: int, relax: bool, deadline, trace,
+        table_fp: Optional[str] = None, epoch_key=None,
     ):
         """Offer one scan-path solve to the current batch window.
 
@@ -293,9 +295,24 @@ class FleetCoalescer:
         when the lane must run the solo path instead (no sibling
         arrived, claim-slot overflow, lane-local or batch-wide failure).
         Never raises for coalescing-machinery faults: the solo path is
-        always the floor."""
+        always the floor.
+
+        `table_fp` is the upload phase's already-computed table
+        fingerprint (tpu.py passes it whenever a DeviceTableCache is
+        wired — the sidecar shape), saving the per-entry re-hash; the
+        window key cannot be the epoch id ALONE because the shared
+        tables also hash the pod batch's topology-group tables, so two
+        same-epoch solves with different spread/affinity mixes must land
+        in different windows. `epoch_key` ((client, epoch id), when the
+        sidecar materialized this request from a resident epoch) rides
+        the lane's window event: same-epoch lanes are visible sharing
+        one window — and, through the cache's table-level single-flight,
+        one device materialization."""
         lane = _Lane(sched, problem, tb, order, N, relax, deadline, trace)
-        key = (epochs.table_fingerprint(problem), int(N), bool(relax))
+        lane.epoch_key = epoch_key
+        if table_fp is None:
+            table_fp = epochs.table_fingerprint(problem)
+        key = (table_fp, int(N), bool(relax))
         with tracing.span_of(trace, "fleet_dispatch"):
             try:
                 result = self._submit(key, lane)
@@ -325,14 +342,16 @@ class FleetCoalescer:
             return None
         FLEET_SOLVES.inc({"mode": "coalesced"})
         if trace is not None:
-            trace.event(
-                "fleet_window",
+            attrs = dict(
                 mode="coalesced",
                 lanes=lane.lanes_in_window,
                 bucket=buckets.bucket_lanes(lane.lanes_in_window),
                 wait_seconds=round(wait, 6),
                 rounds=lane.rounds,
             )
+            if lane.epoch_key is not None:
+                attrs["epoch"] = str(lane.epoch_key)
+            trace.event("fleet_window", **attrs)
             # rounds can be 0 (a lane whose deadline was blown before
             # the first shared round): no phantom dispatch on the trace
             if lane.rounds:

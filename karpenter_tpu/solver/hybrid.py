@@ -103,6 +103,7 @@ class HybridScheduler:
         force_oracle: bool = False,
         table_cache=None,
         fleet=None,
+        epoch_key=None,
     ):
         self.force_oracle = force_oracle
         self.used_tpu: Optional[bool] = None
@@ -138,6 +139,9 @@ class HybridScheduler:
                 # fleet.FleetCoalescer (optional): scan-path solves join
                 # the server's batch window and share vmapped dispatches
                 fleet=fleet,
+                # (client, epoch id) when the request was materialized
+                # from a resident epoch — rides the fleet window event
+                epoch_key=epoch_key,
             )
             self.oracle = self.tpu.oracle
         self.opts = self.oracle.opts
@@ -304,6 +308,7 @@ def solve_in_process(
     trace=None,
     table_cache=None,
     fleet=None,
+    epoch_key=None,
 ) -> tuple[Results, HybridScheduler]:
     """THE in-process solve assembly: Topology + HybridScheduler, options
     threaded consistently. Every path that solves locally — the
@@ -338,6 +343,7 @@ def solve_in_process(
             force_oracle=force_oracle,
             table_cache=table_cache,
             fleet=fleet,
+            epoch_key=epoch_key,
         )
         return scheduler.solve(pods, trace=tr), scheduler
 

@@ -1048,7 +1048,10 @@ class SolverServer:
         epochs.EPOCH_SOLVES.inc(
             {"mode": "full_resync" if epoch_info else "snapshot"}
         )
-        out = self._solve_decoded(decoded, tr)
+        epoch_key = None
+        if isinstance(epoch_info, dict):
+            epoch_key = (epoch_info.get("client"), epoch_info.get("id"))
+        out = self._solve_decoded(decoded, tr, epoch_key=epoch_key)
         if isinstance(epoch_info, dict):
             self._store_epoch(
                 gen0,
@@ -1127,7 +1130,9 @@ class SolverServer:
         # stay resident, so either retry shape converges
         self._store_epoch(gen0, client, new_epoch, sections)
         epochs.EPOCH_SOLVES.inc({"mode": "delta"})
-        return KIND_RESULT, self._solve_decoded(decoded, tr)
+        return KIND_RESULT, self._solve_decoded(
+            decoded, tr, epoch_key=(client, new_epoch)
+        )
 
     def _current_epoch_gen(self) -> int:
         with self._stats_lock:
@@ -1144,7 +1149,7 @@ class SolverServer:
         if current:
             self.epochs.put(str(client), epoch_id, sections)
 
-    def _solve_decoded(self, decoded: tuple, tr) -> bytes:
+    def _solve_decoded(self, decoded: tuple, tr, epoch_key=None) -> bytes:
         (
             node_pools,
             its_by_pool,
@@ -1177,6 +1182,10 @@ class SolverServer:
             trace=tr,
             table_cache=self.table_cache,
             fleet=self.fleet,
+            # the request's epoch identity (when it rode the epoch
+            # machinery): a coalesced window's trace then shows which
+            # epochs shared the materialization (solver/fleet.py)
+            epoch_key=epoch_key,
         )
         with self._stats_lock:
             self.solves += 1

@@ -41,7 +41,7 @@ from karpenter_tpu.ops.kernels import VocabArrays
 from karpenter_tpu.scheduling import Requirement, Requirements
 from karpenter_tpu.solver import buckets
 from karpenter_tpu.solver import nodes as nodes_mod
-from karpenter_tpu.solver.epochs import problem_fingerprint
+from karpenter_tpu.solver.epochs import problem_fingerprint, table_fingerprint
 from karpenter_tpu.solver.nodes import (
     SchedulingNodeClaim,
     StateNodeView,
@@ -467,6 +467,14 @@ _DecodeView = collections.namedtuple(
 class TpuScheduler:
     """Same surface as oracle.Scheduler, solving on the accelerator."""
 
+    # Testing knob (testing/fuzz.py dual-path parity): force the exact
+    # per-pod SCAN step even when every class passes the bulk gates. The
+    # scan path is always semantically valid — the runs kernel is purely
+    # an iteration-count optimization over it — so forcing it re-checks
+    # the same decisions through the other compiled program. Never set
+    # in production paths.
+    debug_force_scan = False
+
     def __init__(
         self,
         node_pools: list[NodePool],
@@ -477,6 +485,7 @@ class TpuScheduler:
         options: Optional[SchedulerOptions] = None,
         table_cache=None,
         fleet=None,
+        epoch_key=None,
     ):
         # reuse the oracle's init wholesale: template filtering, daemon
         # overhead, existing-node ordering, limits (scheduler.go:116)
@@ -504,6 +513,11 @@ class TpuScheduler:
         # (no sibling, overflow, coalescing fault) runs the solo loop
         # below unchanged
         self._fleet = fleet
+        # (client, epoch id) of the request this scheduler serves, when
+        # the sidecar materialized it from a resident epoch (service.py
+        # threads it): rides the fleet window's trace event, so a
+        # waterfall shows WHICH epochs shared one materialization
+        self._epoch_key = epoch_key
         self.last_used_fleet = False
 
     # -- solve ----------------------------------------------------------
@@ -556,6 +570,7 @@ class TpuScheduler:
         with prof.span("upload"):
             cached = None
             fp = None
+            tfp = None
             if self._table_cache is not None:
                 fp = problem_fingerprint(problem)
                 cached = self._table_cache.get(fp)
@@ -567,14 +582,41 @@ class TpuScheduler:
                 upload_bytes = 0
                 prof.event("table_cache", outcome="hit")
             else:
-                tb = self._tables(problem)  # also sets self._typeok
-                self._upload_pod_tables(problem)
-                upload_bytes = _tree_nbytes(tb) + _tree_nbytes(self._dev_tables)
+                tb = None
+                token = None
+                if self._table_cache is not None:
+                    # single-flight on the TABLE fingerprint: concurrent
+                    # same-epoch solves (a fleet window's lanes all
+                    # encoding before any put lands) elect one builder
+                    # for the shared Tables pytree; the rest block here
+                    # and reuse it — one materialization per window
+                    tfp = table_fingerprint(problem)
+                    tb, token = self._table_cache.begin_tables(tfp)
+                try:
+                    if tb is not None:
+                        # shared-tables hit: tb is a pure function of the
+                        # table-hashed fields (fleet.py's stacking
+                        # precondition), so only the per-lane pod tables
+                        # rebuild against the resident pytree
+                        self._typeok = self._pod_typeok(problem, tb)
+                        self._upload_pod_tables(problem)
+                        upload_bytes = _tree_nbytes(self._dev_tables)
+                        prof.event("table_cache", outcome="tables_hit")
+                    else:
+                        tb = self._tables(problem)  # also sets self._typeok
+                        self._upload_pod_tables(problem)
+                        upload_bytes = _tree_nbytes(tb) + _tree_nbytes(
+                            self._dev_tables
+                        )
+                        if self._table_cache is not None:
+                            prof.event("table_cache", outcome="miss")
+                finally:
+                    if self._table_cache is not None:
+                        self._table_cache.end_tables(token, tb)
                 if self._table_cache is not None:
                     self._table_cache.put(
                         fp, (tb, self._typeok, self._dev_tables, self._aff_c)
                     )
-                    prof.event("table_cache", outcome="miss")
         if upload_bytes:
             prof.count("upload_bytes", by=upload_bytes)
             tracing.SOLVE_UPLOAD_BYTES.inc(by=upload_bytes)
@@ -585,7 +627,7 @@ class TpuScheduler:
         # — the ladder must not tax preference-free workloads)
         relax = bool((problem.ntiers_r > 1).any())
         self.last_relax = relax
-        use_runs = bool(self._bulk_flags_c.any())
+        use_runs = bool(self._bulk_flags_c.any()) and not self.debug_force_scan
         self.last_used_runs = use_runs  # introspection for tests/bench
         if use_runs:
             self._set_runflags_dev()
@@ -624,7 +666,11 @@ class TpuScheduler:
         self.last_used_fleet = False
         if self._fleet is not None and not use_runs:
             got = self._fleet.solve_lane(
-                self, problem, tb, order, N, relax, deadline, prof
+                self, problem, tb, order, N, relax, deadline, prof,
+                # the upload phase already fingerprinted the tables when a
+                # cache is wired (the sidecar shape); the coalescer reuses
+                # it instead of re-hashing per window entry
+                table_fp=tfp, epoch_key=self._epoch_key,
             )
             if got is not None:
                 st, kinds, slots, timed_out = got

@@ -720,6 +720,23 @@ def encode_problem(scheduler: Scheduler, pods: list[Pod]) -> EncodedProblem:
 
     # ---- pods ----------------------------------------------------------
     _encode_pod_classes(p, pods, group_vid, class_reqs)
+    # Best-effort minValues (MinValuesPolicy=BestEffort): the oracle's
+    # can_add LOWERS an unsatisfiable floor per add and keeps packing
+    # (nodes.py filter_instance_types relax_min_values —
+    # scheduling/nodeclaim.go BestEffort), while the kernel's
+    # _min_values_ok enforces the encoded floor strictly — a pod the
+    # oracle still packs would open a fresh claim on device (found by the
+    # differential fuzzer, corpus pin seed8073). Like strict reserved
+    # offerings above, the policy's per-add mutation stays on the oracle.
+    _gate(
+        scheduler.opts.min_values_best_effort
+        and bool(
+            (p.treq.minv != -1).any()
+            or (p.preq_c.minv != -1).any()
+            or (p.num_existing and (p.ereq.minv != -1).any())
+        ),
+        "best-effort minValues policy with minValues floors present",
+    )
     # bucket the remaining compiled axes (instance types, offerings) —
     # sentinel invisibility arguments live in solver/buckets.py
     buckets.pad_problem(p)

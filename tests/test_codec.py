@@ -143,3 +143,46 @@ def test_unknown_type_rejected():
 
     with pytest.raises(KeyError):
         codec.from_jsonable({"__type__": "NotRegistered", "fields": {}})
+
+
+def test_str_enum_fields_decode_to_typed_members():
+    """Differential-fuzzer regression (corpus pin seed8505): every api
+    enum subclasses str, so the wire carries bare values — which decode
+    as plain `str` unless coerced. A bare-str effect COMPARES equal to
+    its member (str-enum equality), so scheduling decisions were never
+    wrong, but `taint.effect.value` in the oracle's did-not-tolerate
+    error path crashed a sidecar solve. The codec must hand back typed
+    members; the wire bytes stay byte-identical (pre-fix senders, the
+    C++ client)."""
+    from karpenter_tpu.api.objects import NodeInclusionPolicy, Pod, PodPhase
+
+    taint = roundtrip(Taint(key="team", value="a", effect=TaintEffect.NO_EXECUTE))
+    assert isinstance(taint.effect, TaintEffect)
+    assert taint.effect.value == "NoExecute"  # the crash site
+
+    tol = roundtrip(
+        Toleration(key="team", operator="Equal", value="a",
+                   effect=TaintEffect.NO_SCHEDULE)
+    )
+    assert isinstance(tol.effect, TaintEffect)
+    assert roundtrip(Toleration(key="any")).effect is None  # None survives
+
+    tsc = roundtrip(
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="zone",
+            when_unsatisfiable=WhenUnsatisfiable.SCHEDULE_ANYWAY,
+        )
+    )
+    assert isinstance(tsc.when_unsatisfiable, WhenUnsatisfiable)
+    assert isinstance(tsc.node_affinity_policy, NodeInclusionPolicy)
+
+    pod = roundtrip(fixtures.pod(name="p"))
+    assert isinstance(pod.phase, PodPhase)
+
+    nsr = roundtrip(NodeSelectorRequirement("k", Operator.GT, ["4"]))
+    assert isinstance(nsr.operator, Operator)
+
+    # the wire form is unchanged: bare enum VALUES, no __enum__ envelope
+    encoded = codec.to_jsonable(taint)
+    assert encoded["effect"] == "NoExecute"

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import tempfile
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -307,3 +308,125 @@ def test_table_fingerprint_groups_profiles_not_clusters():
     assert t1 == t2, "distinct request profiles must share a table key"
     assert p1 != p2, "the full problem fingerprint must still differ"
     assert t1 != t3, "a different cluster must never share a table key"
+
+
+# ---------------------------------------------------------------------------
+# epoch-keyed window sharing (ROADMAP item 3 leftover, round 13): one
+# DeviceTableCache materialization serves a whole coalesced window
+
+
+def _fleet_lane_sched(cpu: str, cache, coalescer):
+    pools, ibp, pods = _problem(cpu)
+    topo = Topology(pools, ibp, pods)
+    return (
+        TpuScheduler(pools, ibp, topo, table_cache=cache, fleet=coalescer),
+        pods,
+    )
+
+
+def _drive_window(profiles, cache, coalescer):
+    """Run one coalesced window (len(profiles) concurrent lanes over a
+    shared cache) and return the per-window `_tables` materialization
+    count."""
+    from karpenter_tpu.analysis.ir import count_method_calls
+
+    lanes = [_fleet_lane_sched(cpu, cache, coalescer) for cpu in profiles]
+    errors: list[BaseException] = []
+
+    def run(sched, pods) -> None:
+        try:
+            sched.solve(pods)
+        except BaseException as e:  # surfaced below
+            errors.append(e)
+
+    with count_method_calls(TpuScheduler, ("_tables",)) as calls:
+        threads = [
+            threading.Thread(target=run, args=lane, daemon=True)
+            for lane in lanes
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=WIRE_TIMEOUT)
+    assert not errors, errors
+    assert all(s.last_used_fleet for s, _ in lanes), "lanes did not coalesce"
+    return calls["_tables"]
+
+
+def test_coalesced_window_materializes_tables_once():
+    """The regression pin on the PR-11 leftover: a coalesced window whose
+    lanes carry DISTINCT request profiles (different problem
+    fingerprints — the full-entry cache can't serve them) materializes
+    the shared `Tables` pytree exactly ONCE. The first window used to
+    race both lanes into `_tables` (the old ceiling-2 budget); the
+    table-level single-flight (epochs.DeviceTableCache.begin_tables)
+    elects one builder, so first window == 1 and a repeat window == 0
+    (resident), matching the `fleet[runtime]` budget in
+    kernel_budgets.json."""
+    cache = epochs.DeviceTableCache()
+    coalescer = fleet.FleetCoalescer(window_seconds=10.0, max_lanes=2)
+    first = _drive_window(_PROFILES[:2], cache, coalescer)
+    assert first == 1, f"first window materialized {first}x (want 1)"
+    repeat = _drive_window(_PROFILES[2:4], cache, coalescer)
+    assert repeat == 0, f"repeat window materialized {repeat}x (want 0)"
+
+
+def test_table_cache_single_flight_election():
+    """epochs.DeviceTableCache.begin_tables/end_tables mechanics: one
+    builder per key, waiters take the published pytree, a failed publish
+    re-elects the waiter, and the shared-tables LRU stays bounded."""
+    cache = epochs.DeviceTableCache(capacity=2)
+
+    # election: first caller builds, publish makes it resident
+    tb, token = cache.begin_tables("k1")
+    assert tb is None and token == "k1"
+    done: list = []
+
+    def waiter() -> None:
+        done.append(cache.begin_tables("k1"))
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    cache.end_tables(token, "TB1")
+    t.join(timeout=10)
+    assert done == [("TB1", None)], done
+    assert cache.get_tables("k1") == "TB1"
+
+    # failed publish (builder died building): the waiter is re-elected
+    _tb, token2 = cache.begin_tables("k2")
+    relay: list = []
+
+    def failed_waiter() -> None:
+        relay.append(cache.begin_tables("k2"))
+
+    t2 = threading.Thread(target=failed_waiter, daemon=True)
+    t2.start()
+    cache.end_tables(token2, None)  # publish failure: no pytree
+    t2.join(timeout=10)
+    assert relay == [(None, "k2")], relay  # waiter must now build
+    cache.end_tables("k2", "TB2")
+
+    # LRU: capacity 2 evicts the oldest shared-tables entry
+    cache.put_tables("k3", "TB3")
+    assert cache.get_tables("k1") is None, "k1 should have aged out"
+    assert cache.get_tables("k2") == "TB2"
+    assert cache.get_tables("k3") == "TB3"
+
+
+def test_table_cache_dead_builder_key_recovers():
+    """A builder that dies WITHOUT reaching end_tables (hard thread
+    death) must not wedge its key: the timed-out waiter evicts the stale
+    election, so the NEXT caller is elected immediately instead of every
+    future solve on that fingerprint stalling the full BUILD_WAIT."""
+    cache = epochs.DeviceTableCache()
+    cache.BUILD_WAIT_SECONDS = 0.05
+    _tb, token = cache.begin_tables("kd")
+    assert token == "kd"  # we are the builder — and we never publish
+    got = cache.begin_tables("kd")  # waiter: times out on the dead build
+    assert got == (None, None), got  # degraded: build our own copy
+    # the key has RECOVERED: a fresh caller is elected builder at once
+    t0 = time.monotonic()
+    _tb2, token2 = cache.begin_tables("kd")
+    assert token2 == "kd" and time.monotonic() - t0 < 1.0
+    cache.end_tables(token2, "TBD")
+    assert cache.get_tables("kd") == "TBD"

@@ -1,0 +1,278 @@
+"""Differential chaos fuzzer gates (karpenter_tpu/testing/fuzz.py).
+
+Three tiers over the same seeded case stream:
+
+- the PINNED CORPUS replays first: every counterexample the fuzzer ever
+  shrank is a permanent regression scenario (tests/fuzz_corpus/*.json),
+  replayed through the mode that caught it;
+- the SMOKE tier: a fixed-seed batch (FUZZ_SEED overrides the base,
+  FUZZ_CASES the count; default 64) through parity + invariant modes —
+  runs inside tier-1's budget, zero violations tolerated;
+- the DEEP tier (`-m "fuzz and slow"`): 1000+ cases plus the chaos-mode
+  scenario rotation through a live sidecar under the shared fault proxy.
+
+On any violation the failing case auto-shrinks, lands in the corpus, and
+the assertion message prints the exact repro command (fuzz.repro_command)
+— seed in, bug out, forever.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from karpenter_tpu.testing import fuzz
+
+pytestmark = [pytest.mark.fuzz]
+
+SMOKE_CASES = max(1, int(os.environ.get("FUZZ_CASES", "64")))
+BASE_SEED = fuzz.fuzz_seed_base()
+
+
+def _check_mode(case: fuzz.FuzzCase, mode: str, tmp_path=None) -> list[str]:
+    if mode == "parity":
+        return fuzz.check_parity(case)
+    if mode == "invariants":
+        return fuzz.check_invariants(case)
+    if mode.startswith("chaos:"):
+        return fuzz.chaos_violations(case, mode.split(":", 1)[1], str(tmp_path))
+    raise ValueError(mode)
+
+
+def _fail_with_repro(failures: list) -> None:
+    lines = []
+    for seed, mode, violation, corpus_path in failures:
+        lines.append(
+            f"seed {seed} [{mode}]: {violation}\n"
+            f"  shrunk case pinned at {corpus_path}\n"
+            f"  repro: {fuzz.repro_command(seed, mode)}"
+        )
+    pytest.fail(
+        f"{len(failures)} fuzz violation(s):\n" + "\n".join(lines), pytrace=False
+    )
+
+
+def _run_batch(seeds, tight_every: int = 4) -> None:
+    failures = []
+    for i, seed in enumerate(seeds):
+        case = fuzz.generate_case(seed)
+        mode = "parity"
+        viols = fuzz.check_parity(case, tight_slots=(i % tight_every == 0))
+        if not viols:
+            mode = "invariants"
+            viols = fuzz.check_invariants(case)
+        if viols:
+            # auto-shrink under the SAME mode, pin, and report the seed
+            checker = (
+                fuzz.check_parity if mode == "parity" else fuzz.check_invariants
+            )
+            shrunk = fuzz.shrink(
+                case, lambda c: bool(checker(c)), max_evals=60
+            )
+            path = fuzz.save_corpus_case(shrunk, mode, viols[0])
+            failures.append((seed, mode, viols[0], path))
+    if failures:
+        _fail_with_repro(failures)
+
+
+# ---------------------------------------------------------------------------
+# 1. the pinned corpus replays FIRST — counterexamples are regressions
+
+
+@pytest.mark.faults  # chaos-mode entries drive a live server + proxy
+@pytest.mark.hard_timeout(600)
+def test_corpus_exists_and_replays_clean(tmp_path):
+    entries = fuzz.load_corpus()
+    assert entries, (
+        "the pinned counterexample corpus (tests/fuzz_corpus/) is empty — "
+        "it must ship with the fuzzer"
+    )
+    failures = []
+    for fn, entry in entries:
+        case = fuzz.corpus_case(entry)
+        mode = entry["mode"]
+        viols = _check_mode(case, mode, tmp_path)
+        if viols:
+            failures.append(
+                (entry["seed"], f"corpus:{fn}", viols[0], "already pinned")
+            )
+    if failures:
+        _fail_with_repro(failures)
+
+
+def test_corpus_entries_are_replayable_and_named():
+    """Every corpus file names its seed, mode, and repro command, and its
+    problem dict decodes through the service codec (the replay path)."""
+    for fn, entry in fuzz.load_corpus():
+        assert {"seed", "mode", "violation", "repro", "problem"} <= set(entry), fn
+        assert str(entry["seed"]) in fn
+        case = fuzz.corpus_case(entry)
+        pools, ibp, pods, _views, _daemons, _opts, _src = case.materialize()
+        assert pools and ibp
+        assert str(entry["seed"]) in entry["repro"]
+
+
+# ---------------------------------------------------------------------------
+# 2. the fixed-seed smoke tier (tier-1: ~64 cases, parity + invariants)
+
+
+@pytest.mark.hard_timeout(780)
+def test_seeded_smoke_parity_and_invariants():
+    """The tier-1 gate: SMOKE_CASES seeded cases through parity (both
+    kernel paths, sampled regrow differential, relax on/off) and the
+    invariant catalog — zero violations. FUZZ_SEED replays a CI batch."""
+    _run_batch(range(BASE_SEED, BASE_SEED + SMOKE_CASES))
+
+
+# ---------------------------------------------------------------------------
+# 3. chaos smoke: the same seeded cases through a live sidecar
+
+
+def _small_case() -> fuzz.FuzzCase:
+    """The first case at/after the base seed with a small pod count —
+    chaos replays several solves per scenario, so the smoke tier keeps
+    the per-solve cost bounded. Deterministic: same base, same case."""
+    seed = BASE_SEED
+    while True:
+        case = fuzz.generate_case(seed)
+        if len(case.materialize()[2]) <= 12:
+            return case
+        seed += 1
+
+
+@pytest.mark.faults
+@pytest.mark.hard_timeout(240)
+@pytest.mark.parametrize("scenario", ["wire", "desync", "kill", "retry"])
+def test_chaos_smoke_scenarios(scenario, tmp_path):
+    """A seeded fuzz case driven through a live SolverServer under fault
+    injection (shared FaultyProxy / epoch desync / server kill /
+    admission RETRY) answers decision-identically to the in-process
+    oracle referee, every time."""
+    case = _small_case()
+    viols = fuzz.chaos_violations(case, scenario, str(tmp_path))
+    if viols:
+        _fail_with_repro(
+            [(case.seed, f"chaos:{scenario}", v, "not pinned (rerun shrinks)")
+             for v in viols]
+        )
+
+
+@pytest.mark.faults
+@pytest.mark.hard_timeout(600)
+def test_chaos_fleet_window_with_sibling_lanes(tmp_path):
+    """Fleet-window chaos: seeded sibling lanes (distinct request
+    profiles of the shared scan-path fixture) coalesce through one
+    window on a live fleet server behind the fault proxy — a one-shot
+    delayed response lands mid-window — and every lane's claims equal
+    its solo in-process solve."""
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.solver import epochs, fleet
+    from karpenter_tpu.solver.service import SolverClient, SolverServer
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import TpuScheduler
+    from karpenter_tpu.testing import fixtures
+    from karpenter_tpu.testing.faults import FaultyProxy
+
+    lanes = 3
+    base = 1 + BASE_SEED % 3
+    profiles = [f"{100 * (base + k)}m" for k in range(lanes)]
+
+    def _problem(cpu):
+        fixtures.reset_rng(5)
+        its = construct_instance_types(sizes=[2, 8])
+        pools = [fixtures.node_pool(name="default")]
+        pods = fixtures.make_self_spread_pods(6, cpu)
+        return pools, {"default": its}, pods
+
+    def _solo(cpu):
+        pools, ibp, pods = _problem(cpu)
+        topo = Topology(pools, ibp, pods)
+        sched = TpuScheduler(pools, ibp, topo)
+        r = sched.solve(pods)
+        assert not sched.last_used_runs
+        return sorted(
+            tuple(sorted(p.name for p in c.pods))
+            for c in r.new_node_claims
+            if c.pods
+        )
+
+    refs = {cpu: _solo(cpu) for cpu in profiles}
+    sock = str(tmp_path / "fz-fleet.sock")
+    srv = SolverServer(
+        sock,
+        fleet_window_seconds=10.0,
+        fleet_max_lanes=lanes,
+        admission=epochs.AdmissionGate(max_inflight=32),
+    )
+    srv.start()
+    proxy = FaultyProxy(str(tmp_path / "fz-fleet.proxy.sock"), sock)
+    proxy.set_fault("delay", once=True, delay=0.2)
+    c0 = fleet.FLEET_SOLVES.value({"mode": "coalesced"})
+    out: dict[str, list] = {}
+    errors: dict[str, BaseException] = {}
+    barrier = threading.Barrier(lanes)
+
+    def client(cpu: str) -> None:
+        try:
+            c = SolverClient(proxy.listen_path, request_timeout=600.0)
+            pools, ibp, pods = _problem(cpu)
+            barrier.wait()
+            got = c.solve(pools, ibp, pods)
+            name = {p.uid: p.name for p in pods}
+            out[cpu] = sorted(
+                tuple(sorted(name[u] for u in cl["pod_uids"]))
+                for cl in got["new_node_claims"]
+                if cl["pod_uids"]
+            )
+            c.close()
+        except BaseException as e:  # asserted below
+            errors[cpu] = e
+
+    try:
+        threads = [
+            threading.Thread(target=client, args=(cpu,), daemon=True)
+            for cpu in profiles
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+    finally:
+        proxy.stop()
+        srv.stop()
+    assert not errors, errors
+    for cpu in profiles:
+        assert out[cpu] == refs[cpu], cpu
+    assert fleet.FLEET_SOLVES.value({"mode": "coalesced"}) - c0 == lanes
+
+
+# ---------------------------------------------------------------------------
+# 4. the deep tier (`-m "fuzz and slow"`): breadth + chaos rotation
+
+
+@pytest.mark.slow
+@pytest.mark.hard_timeout(3600)
+@pytest.mark.parametrize("batch", range(10))
+def test_seeded_deep_batch(batch):
+    """1000 cases beyond the smoke window, 100 per batch — the
+    adversarial sweep every kernel/serving PR reruns."""
+    start = BASE_SEED + 1000 + batch * 100
+    _run_batch(range(start, start + 100), tight_every=8)
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+@pytest.mark.hard_timeout(1800)
+@pytest.mark.parametrize("scenario", ["wire", "desync", "kill", "retry"])
+def test_chaos_deep_rotation(scenario, tmp_path):
+    """Chaos breadth: a rotation of seeded cases (not just the small
+    one) through every fault scenario."""
+    failures = []
+    for seed in range(BASE_SEED + 500, BASE_SEED + 512):
+        case = fuzz.generate_case(seed)
+        for v in fuzz.chaos_violations(case, scenario, str(tmp_path)):
+            failures.append((seed, f"chaos:{scenario}", v, "not pinned"))
+    if failures:
+        _fail_with_repro(failures)

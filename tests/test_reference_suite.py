@@ -1423,3 +1423,256 @@ def test_capacity_type_spread_do_not_schedule_blocks():
     r = _spot_seeded_problem(pods).solve(pods)
     placed = [i for i in range(5) if scheduled(r, f"ct-{i}")]
     assert len(placed) == 2, (placed, r.pod_errors)
+
+
+# ---------------------------------------------------------------------------
+# NodeOverlay pricing/capacity overlays (round 13, TESTMAP §4:
+# pkg/controllers/nodeoverlay/suite_test.go). The overlay controller
+# evaluates overlays weight-ordered into a swap-on-write store
+# (nodeoverlay/controller.go:69); these scenarios pin the SCHEDULING
+# consequences — launch-price reordering and injected extended capacity —
+# not just the patched numbers.
+
+
+def _overlay_op():
+    from karpenter_tpu.cloudprovider.decorators import (
+        InstanceTypeStore,
+        OverlayCloudProvider,
+    )
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.nodeoverlay import NodeOverlayController
+    from karpenter_tpu.controllers.operator import Operator as Op
+
+    op = Op(clock=FakeClock(), force_oracle=True)
+    op.raw_cloud.types = construct_instance_types(sizes=[2, 8])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    op.kube.create("NodePool", fixtures.node_pool(name="default"))
+    store = InstanceTypeStore()
+    ctrl = NodeOverlayController(op.kube, op.cloud, store)
+    return op, store, ctrl, OverlayCloudProvider(op.cloud, store)
+
+
+def test_overlay_absolute_price_reorders_launch_choice():
+    """nodeoverlay/suite_test.go:132 ("should update the price ...") +
+    to_node_claim's price ordering (solver/nodes.py:260): an absolute
+    price of ~0 on the 8-cpu family makes it the cheapest LAUNCH choice
+    where the 2-cpu type won before."""
+    from karpenter_tpu.api.objects import ObjectMeta
+    from karpenter_tpu.controllers.nodeoverlay import NodeOverlay
+
+    op, store, ctrl, overlay_cloud = _overlay_op()
+    np_ = op.kube.list("NodePool")[0]
+
+    from karpenter_tpu.cloudprovider.types import InstanceTypes
+
+    def cheapest_name(its):
+        pods = [fixtures.pod(name="p0", requests={"cpu": "100m"})]
+        r = solve(pods, pools=[np_], its=InstanceTypes(its))
+        claim = claim_of(r, "p0")
+        # the launch choice: to_node_claim injects the price-ordered
+        # option list (nodeclaimtemplate.go:79); order the claim's
+        # surviving options the same way and take the head
+        ordered = InstanceTypes(claim.instance_type_options).order_by_price(
+            claim.requirements
+        )
+        return ordered[0].name if ordered else None
+
+    before = cheapest_name(op.cloud.get_instance_types(np_))
+    assert before is not None and "-2x-" in before
+
+    # select the 8x types only, by type name (the overlay requirement
+    # matches instance-type labels, suite_test.go:132)
+    op.kube.create(
+        "NodeOverlay",
+        NodeOverlay(
+            metadata=ObjectMeta(name="big-discount"),
+            requirements=[
+                NodeSelectorRequirement(
+                    ITYPE,
+                    Operator.IN,
+                    [
+                        it.name
+                        for it in op.cloud.get_instance_types(np_)
+                        if "-8x-" in it.name
+                    ],
+                )
+            ],
+            price=0.0001,
+        ),
+    )
+    assert ctrl.reconcile_all() == {}
+    after = cheapest_name(overlay_cloud.get_instance_types(np_))
+    assert after is not None and "-8x-" in after, after
+
+
+def test_overlay_weight_order_highest_wins_per_field():
+    """nodeoverlay/suite_test.go:212 (ordered evaluation + conflict
+    rules, controller.go:69): two price overlays hit the same types —
+    the higher-weight one applies, the lower never stacks on top."""
+    from karpenter_tpu.api.objects import ObjectMeta
+    from karpenter_tpu.controllers.nodeoverlay import NodeOverlay
+
+    op, store, ctrl, overlay_cloud = _overlay_op()
+    np_ = op.kube.list("NodePool")[0]
+    base = {it.name: it.offerings[0].price for it in op.cloud.get_instance_types(np_)}
+    op.kube.create(
+        "NodeOverlay",
+        NodeOverlay(
+            metadata=ObjectMeta(name="strong"), weight=10, price_adjustment="-50%"
+        ),
+    )
+    op.kube.create(
+        "NodeOverlay",
+        NodeOverlay(
+            metadata=ObjectMeta(name="weak"), weight=1, price_adjustment="-90%"
+        ),
+    )
+    assert ctrl.reconcile_all() == {}
+    for it in overlay_cloud.get_instance_types(np_):
+        assert it.offerings[0].price == pytest.approx(base[it.name] * 0.5), it.name
+
+
+def test_overlay_injected_capacity_makes_extended_resource_schedulable():
+    """nodeoverlay/suite_test.go:303 ("Capacity"): a pod requesting an
+    extended resource no instance type carries is unschedulable until an
+    overlay injects the capacity — then it schedules, and the claim's
+    accumulated requests count the resource in integer milli-units."""
+    from karpenter_tpu.api.objects import ObjectMeta
+    from karpenter_tpu.controllers.nodeoverlay import NodeOverlay
+    from karpenter_tpu.cloudprovider.types import InstanceTypes
+
+    op, store, ctrl, overlay_cloud = _overlay_op()
+    np_ = op.kube.list("NodePool")[0]
+
+    def try_solve(its):
+        pods = [
+            fixtures.pod(
+                name="gpu-pod",
+                requests={"cpu": "100m", "smarter.sh/renewable": 2},
+            )
+        ]
+        return solve(pods, pools=[np_], its=InstanceTypes(its))
+
+    r = try_solve(op.cloud.get_instance_types(np_))
+    assert not scheduled(r, "gpu-pod")
+
+    op.kube.create(
+        "NodeOverlay",
+        NodeOverlay(
+            metadata=ObjectMeta(name="renewable"),
+            capacity={"smarter.sh/renewable": 4000},
+        ),
+    )
+    assert ctrl.reconcile_all() == {}
+    r = try_solve(overlay_cloud.get_instance_types(np_))
+    assert scheduled(r, "gpu-pod")
+    claim = claim_of(r, "gpu-pod")
+    assert claim.requests.get("smarter.sh/renewable") == 2000  # milli-units
+
+
+# ---------------------------------------------------------------------------
+# Static capacity (round 13, TESTMAP §4: pkg/controllers/static/
+# provisioning/suite_test.go + deprovisioning/suite_test.go). The aux
+# suite covers the replica loop mechanics; these pin the reference's
+# limit and ordering scenarios.
+
+
+def _static_op():
+    from karpenter_tpu.cloudprovider.kwok import construct_instance_types
+    from karpenter_tpu.controllers.kube import FakeClock
+    from karpenter_tpu.controllers.operator import Operator as Op
+    from karpenter_tpu.options import FeatureGates, Options
+
+    op = Op(
+        clock=FakeClock(),
+        force_oracle=True,
+        options=Options(feature_gates=FeatureGates(static_capacity=True)),
+    )
+    op.raw_cloud.types = construct_instance_types(sizes=[2])
+    op.raw_cloud._by_name = {it.name: it for it in op.raw_cloud.types}
+    return op
+
+
+def test_static_replicas_capped_by_nodes_limit():
+    """static/provisioning/suite_test.go:118 ("should not provision past
+    the nodes limit", controller.go:93 reserve-against-limit): replicas=5
+    under limits.nodes=3 creates exactly 3 claims, and repeat reconciles
+    never burst past the reservation."""
+    from karpenter_tpu.controllers.static import StaticProvisioning
+
+    op = _static_op()
+    op.kube.create(
+        "NodePool",
+        fixtures.node_pool(name="warm", replicas=5, limits={"nodes": "3"}),
+    )
+    prov = StaticProvisioning(op.kube, op.cluster, op.recorder)
+    assert prov.reconcile_all() == 3
+    assert prov.reconcile_all() == 0
+    assert len(op.kube.list("NodeClaim")) == 3
+
+
+def test_static_scale_down_removes_emptiest_first():
+    """static/deprovisioning/suite_test.go:146 ("should delete the
+    emptiest nodes first", controller.go:84): three static nodes, pods
+    bound to two — scaling replicas to 2 deletes exactly the empty one."""
+    from karpenter_tpu.api.objects import PodPhase
+    from karpenter_tpu.controllers.static import (
+        StaticDeprovisioning,
+        StaticProvisioning,
+    )
+
+    op = _static_op()
+    op.kube.create("NodePool", fixtures.node_pool(name="warm", replicas=3))
+    StaticProvisioning(op.kube, op.cluster, op.recorder).reconcile_all()
+    op.run_until_settled(max_ticks=30)
+    nodes = sorted(n.name for n in op.kube.list("Node"))
+    assert len(nodes) == 3
+    for i, node_name in enumerate(nodes[:2]):
+        rider = fixtures.pod(name=f"rider-{i}", requests={"cpu": "100m"})
+        rider.node_name = node_name
+        rider.phase = PodPhase.RUNNING
+        op.kube.create("Pod", rider)
+    np_ = op.kube.list("NodePool")[0]
+    np_.replicas = 2
+    op.kube.update("NodePool", np_)
+    assert StaticDeprovisioning(op.kube, op.cluster, op.recorder).reconcile_all() == 1
+    deleting = [
+        c.name
+        for c in op.kube.list("NodeClaim")
+        if c.metadata.deletion_timestamp is not None
+    ]
+    # the one deleted claim is the node with zero riders
+    empty = nodes[2]
+    claims_by_node = {
+        c.status.node_name: c.name for c in op.kube.list("NodeClaim")
+    }
+    assert deleting == [claims_by_node[empty]]
+
+
+def test_static_pool_invisible_to_dynamic_provisioning():
+    """static/provisioning/suite_test.go:89 + provisioning.py:356: a
+    static pool never CREATES claims for pending pods. Its existing
+    nodes still serve them (they are ordinary cluster nodes), so the pin
+    is two-phase: a filler pod lands on the static node, then an
+    overflow pod that would fit a FRESH node stays pending — a dynamic
+    pool would have provisioned one, the static pool must not."""
+    from karpenter_tpu.controllers.static import StaticProvisioning
+
+    op = _static_op()
+    op.kube.create("NodePool", fixtures.node_pool(name="warm", replicas=1))
+    StaticProvisioning(op.kube, op.cluster, op.recorder).reconcile_all()
+    op.run_until_settled(max_ticks=30)
+    assert len(op.kube.list("NodeClaim")) == 1
+    filler = fixtures.pod(name="filler", requests={"cpu": "1500m"})
+    op.kube.create("Pod", filler)
+    op.run_until_settled(max_ticks=20)
+    assert op.kube.get("Pod", "filler").node_name is not None
+    overflow = fixtures.pod(name="overflow", requests={"cpu": "1000m"})
+    op.kube.create("Pod", overflow)
+    op.run_until_settled(max_ticks=20)
+    # the overflow pod no longer fits the (filled) static node; it WOULD
+    # fit a fresh 2-cpu node, but no dynamic claim may be created from
+    # the static pool
+    assert len(op.kube.list("NodeClaim")) == 1
+    assert not op.kube.get("Pod", "overflow").node_name
