@@ -142,10 +142,12 @@ def make_problem(n_pods: int, its, pods_fn=None, pools_fn=None):
 
 
 def time_tpu(n_pods, its, pods_fn=None, pools_fn=None):
-    """(steady pods/sec, compile seconds, steady phase totals) — compile
-    measured as first-call minus steady-state; phases are the steady
-    run's top-level solve-trace totals (encode/order/upload/dispatch/
-    regrow/decode), so bench rows can show WHERE a regression landed."""
+    """(steady pods/sec, compile seconds, steady phase totals, kernel
+    odometer) — compile measured as first-call minus steady-state; phases
+    are the steady run's top-level solve-trace totals (encode/order/
+    upload/dispatch/regrow/decode), so bench rows can show WHERE a
+    regression landed; the odometer is the steady run's device-truth
+    counter block (TpuScheduler.last_odometer)."""
     from karpenter_tpu.solver.tpu import TpuScheduler
 
     pools, ibp, pods, topo = make_problem(n_pods, its, pods_fn, pools_fn)
@@ -160,12 +162,132 @@ def time_tpu(n_pods, its, pods_fn=None, pools_fn=None):
     r = sched.solve(pods)
     steady = time.monotonic() - t0
     phases = dict(sched.last_profile.top_phases())
+    odo = dict(sched.last_odometer or {})
     log(
         f"  tpu: {steady:.2f}s steady ({n_pods / steady:.0f} pods/s), "
         f"compile {max(0.0, first - steady):.1f}s, {n_err} errors, "
-        f"{len([c for c in r.new_node_claims if c.pods])} claims"
+        f"{len([c for c in r.new_node_claims if c.pods])} claims, "
+        f"{odo.get('steps', 0)} kernel iterations"
     )
-    return n_pods / steady, max(0.0, first - steady), phases
+    return n_pods / steady, max(0.0, first - steady), phases, odo
+
+
+def phase_breakdown(phases: dict) -> tuple[dict, dict]:
+    """(phase_seconds, phase_shares) rounded for a bench row."""
+    total = sum(phases.values()) or 1.0
+    return (
+        {k: round(v, 3) for k, v in sorted(phases.items())},
+        {k: round(v / total, 3) for k, v in sorted(phases.items())},
+    )
+
+
+def odometer_row(odo: dict, n_pods: int) -> dict:
+    """The kernel-odometer columns a bench row records — the pinned
+    before-number the wave-packing PR (ROADMAP item 1) will be judged
+    against: its win must show up as FEWER iterations per pod, not
+    shifted phases."""
+    steps = int(odo.get("steps", 0))
+    return {
+        "kernel_iterations": steps,
+        "iterations_per_pod": round(steps / max(n_pods, 1), 4),
+        "kernel_bulk_steps": int(odo.get("bulk_steps", 0)),
+        "kernel_tier_steps": int(odo.get("tier_steps", 0)),
+        "kernel_dispatches": int(odo.get("dispatches", 0)),
+        "claims_opened": int(odo.get("claims_opened", 0)),
+        "claim_slots": int(odo.get("claim_slots", 0)),
+    }
+
+
+# --- perf-regression sentinel (bench.py --check) ---------------------------
+
+# Explicit tolerances, one place. Throughput is the noisiest number on a
+# shared 1-core container, so its band is wide; phase SHARES are
+# ratio-of-ratios (total-time noise divides out) and iteration counts
+# are deterministic for a pinned problem, so those bands are tight.
+DEFAULT_TOLERANCES = {
+    # current pods/s must be >= baseline * (1 - throughput_drop)
+    "throughput_drop": 0.35,
+    # a phase's share of the solve may grow at most this factor
+    "phase_share_factor": 1.75,
+    # shares below this are noise-dominated and never compared
+    "phase_share_floor": 0.05,
+    # odometer iterations/pod may grow at most this factor (deterministic
+    # up to requeue-round composition; 15% covers bucket-edge wiggle)
+    "iterations_factor": 1.15,
+}
+
+
+def check_regression(current: dict, baseline: dict, tolerances=None) -> list:
+    """Compare one measured bench row against its stored baseline row;
+    returns a list of human-readable failure strings (empty = pass).
+    Pure and import-safe — tests/test_perf_sentinel.py drives it with
+    synthetic rows (including the injected 2x phase-share regression)."""
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(tolerances or {})
+    failures = []
+    base_ps = baseline.get("tpu_pods_per_sec")
+    cur_ps = current.get("tpu_pods_per_sec")
+    if base_ps and cur_ps is not None:
+        floor = base_ps * (1.0 - tol["throughput_drop"])
+        if cur_ps < floor:
+            failures.append(
+                f"throughput regressed: {cur_ps:.1f} pods/s < "
+                f"{floor:.1f} (baseline {base_ps:.1f} - "
+                f"{tol['throughput_drop']:.0%})"
+            )
+    base_shares = baseline.get("phase_shares") or {}
+    for phase, share in (current.get("phase_shares") or {}).items():
+        b = base_shares.get(phase)
+        if b is None or max(b, share) < tol["phase_share_floor"]:
+            continue
+        limit = max(b * tol["phase_share_factor"], tol["phase_share_floor"])
+        if share > limit:
+            failures.append(
+                f"phase share regressed: {phase} at {share:.3f} > "
+                f"{limit:.3f} (baseline {b:.3f} x "
+                f"{tol['phase_share_factor']})"
+            )
+    base_it = baseline.get("iterations_per_pod")
+    cur_it = current.get("iterations_per_pod")
+    if base_it and cur_it:
+        limit = base_it * tol["iterations_factor"]
+        if cur_it > limit:
+            failures.append(
+                f"kernel iterations regressed: {cur_it} iterations/pod > "
+                f"{limit:.4f} (baseline {base_it} x "
+                f"{tol['iterations_factor']})"
+            )
+    return failures
+
+
+def run_check(current: dict, baseline, baseline_row: str, tolerances=None) -> tuple:
+    """(exit_code, report) — 0 pass, 1 regression, 2 no baseline."""
+    if not baseline:
+        return 2, {
+            "ok": False,
+            "baseline_row": baseline_row,
+            "error": (
+                f"no {baseline_row!r} row in BENCH_DETAIL.json — run the "
+                "matching bench first to pin a baseline"
+            ),
+        }
+    failures = check_regression(current, baseline, tolerances)
+    report = {
+        "ok": not failures,
+        "baseline_row": baseline_row,
+        "failures": failures,
+        "tolerances": {**DEFAULT_TOLERANCES, **(tolerances or {})},
+        "current": current,
+        "baseline": {
+            k: baseline.get(k)
+            for k in (
+                "tpu_pods_per_sec", "phase_shares", "kernel_iterations",
+                "iterations_per_pod",
+            )
+            if k in baseline
+        },
+    }
+    return (1 if failures else 0), report
 
 
 def time_oracle_full(n_pods, its, pods_fn=None, pools_fn=None):
@@ -688,9 +810,87 @@ def main() -> None:
             "BENCH_DETAIL.json)"
         ),
     )
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help=(
+            "perf-regression sentinel: measure the headline shape (or "
+            "the --quick smoke shape) and compare throughput, phase "
+            "shares, and odometer iterations/pod against the stored "
+            "BENCH_DETAIL.json row under explicit tolerances; exit 1 on "
+            "regression, 2 when no baseline row exists"
+        ),
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="with --check: print the full indented report (default: one line)",
+    )
+    ap.add_argument(
+        "--inject-phase-regression",
+        metavar="PHASE:FACTOR",
+        default=None,
+        help=(
+            "testing hook for --check: multiply the measured share of "
+            "PHASE by FACTOR before comparing (the acceptance gate's "
+            "synthetic 2x phase-share regression)"
+        ),
+    )
     args = ap.parse_args()
 
     detail: dict[str, dict] = {}
+
+    if args.check:
+        key = "quick_smoke" if args.quick else "headline_diverse"
+        try:
+            with open("BENCH_DETAIL.json") as f:
+                baseline = json.load(f).get(key)
+        except (FileNotFoundError, json.JSONDecodeError):
+            baseline = None
+        n_pods, n_types = (200, 144) if args.quick else (args.pods, args.types)
+        # shape guard: throughput and iterations/pod scale with problem
+        # size, so a --check at a different shape than the baseline row's
+        # would flag (or mask) regressions for shape reasons — that is a
+        # missing-baseline situation (exit 2), not a comparison
+        if baseline is not None and "pods" in baseline:
+            if (baseline["pods"], baseline.get("types")) != (n_pods, n_types):
+                code, report = 2, {
+                    "ok": False,
+                    "baseline_row": key,
+                    "error": (
+                        f"baseline row {key!r} was measured at "
+                        f"{baseline['pods']} pods x {baseline.get('types')} "
+                        f"types, but --check is measuring {n_pods} x "
+                        f"{n_types} — re-pin the baseline at this shape "
+                        "or drop the --pods/--types override"
+                    ),
+                }
+                print(json.dumps(report, indent=2 if args.json else None))
+                sys.exit(code)
+        log(f"== check: {n_pods} pods x {n_types} types vs {key} ==")
+        its = build_universe(n_types)
+        tpu_ps, _comp, phases, odo = time_tpu(n_pods, its)
+        _seconds, shares = phase_breakdown(phases)
+        current = {
+            "tpu_pods_per_sec": round(tpu_ps, 1),
+            "phase_shares": shares,
+            **odometer_row(odo, n_pods),
+        }
+        if args.inject_phase_regression:
+            # deterministic synthetic regression: the phase's share is set
+            # to FACTOR x the BASELINE share (anchoring to the baseline —
+            # not the drifting current measurement — makes the acceptance
+            # gate's 2x injection fail by exactly 2.0 vs the 1.75x band)
+            phase, factor = args.inject_phase_regression.split(":", 1)
+            anchor = (baseline or {}).get("phase_shares", {}).get(
+                phase, current["phase_shares"].get(phase, 0.0)
+            )
+            current["phase_shares"] = dict(current["phase_shares"])
+            current["phase_shares"][phase] = round(anchor * float(factor), 3)
+            current["injected"] = args.inject_phase_regression
+        code, report = run_check(current, baseline, key)
+        print(json.dumps(report, indent=2 if args.json else None))
+        sys.exit(code)
 
     if args.fleet:
         log("== fleet: coalesced vs solo dispatch through one SolverServer ==")
@@ -728,8 +928,23 @@ def main() -> None:
 
     if args.quick:
         its = build_universe(144)
-        tpu_ps, compile_s, _ = time_tpu(200, its)
+        tpu_ps, compile_s, phases, odo = time_tpu(200, its)
         oracle_ps = time_oracle_full(200, its)
+        seconds, shares = phase_breakdown(phases)
+        # the smoke row is a real baseline: bench --check --quick and the
+        # wave-packing PR's before-number both read it (merge-not-clobber)
+        merge_detail({
+            "quick_smoke": {
+                "pods": 200,
+                "types": 144,
+                "tpu_pods_per_sec": round(tpu_ps, 1),
+                "oracle_pods_per_sec": round(oracle_ps, 1),
+                "speedup": round(tpu_ps / oracle_ps, 2),
+                "phase_seconds": seconds,
+                "phase_shares": shares,
+                **odometer_row(odo, 200),
+            }
+        })
         print(json.dumps({
             "metric": "Scheduler.Solve pods/sec at 200 pending x 144 types (quick)",
             "value": round(tpu_ps, 1), "unit": "pods/sec",
@@ -757,7 +972,7 @@ def main() -> None:
 
         log("== config 2: 10k x 500, nodeSelector + taints/tolerations ==")
         its = build_universe(500)
-        tpu_ps, comp, _ = time_tpu(10_000, its, pods_selector_taints, pools_tainted)
+        tpu_ps, comp, _, _ = time_tpu(10_000, its, pods_selector_taints, pools_tainted)
         orc_fn = oracle_curve([1000, 2000, 4000], its, pods_selector_taints, pools_tainted)
         orc = orc_fn(10_000)
         detail["c2_10kx500_selector_taints"] = {
@@ -768,7 +983,7 @@ def main() -> None:
 
         log("== config 3: 5k topology-heavy (spread + anti, 3 zones) ==")
         its = build_universe(500)
-        tpu_ps, comp, _ = time_tpu(5_000, its, pods_topology_heavy, pools_three_zones)
+        tpu_ps, comp, _, _ = time_tpu(5_000, its, pods_topology_heavy, pools_three_zones)
         orc_fn = oracle_curve([500, 1000, 2000], its, pods_topology_heavy, pools_three_zones)
         orc = orc_fn(5_000)
         detail["c3_5k_topology_heavy"] = {
@@ -813,7 +1028,7 @@ def main() -> None:
 
         log("== config 5: 50k x 1k, mixed spot/on-demand ==")
         its = build_universe(1000)
-        tpu_ps, comp, _ = time_tpu(50_000, its)
+        tpu_ps, comp, _, _ = time_tpu(50_000, its)
         orc_fn = oracle_curve([1000, 2000, 4000], its)
         orc = orc_fn(50_000)
         detail["c5_50kx1k_mixed"] = {
@@ -826,22 +1041,25 @@ def main() -> None:
     log("== headline: diverse mix, full-size oracle baseline ==")
     its = build_universe(args.types)
     log(f"universe: {len(its)} instance types")
-    tpu_ps, compile_s, phases = time_tpu(args.pods, its)
+    tpu_ps, compile_s, phases, odo = time_tpu(args.pods, its)
     oracle_ps = time_oracle_full(args.pods, its)
     # per-phase breakdown of the steady headline run (tracing top-level
     # spans): future bench rows show WHERE a regression landed — encode,
     # upload, device dispatch, or decode — not just that one did
-    phase_total = sum(phases.values()) or 1.0
+    seconds, shares = phase_breakdown(phases)
     detail["headline_diverse"] = {
+        "pods": args.pods,
+        "types": len(its),
         "tpu_pods_per_sec": round(tpu_ps, 1),
         "oracle_pods_per_sec": round(oracle_ps, 1),
         "speedup": round(tpu_ps / oracle_ps, 2),
         "compile_seconds": round(compile_s, 1),
         "baseline_kind": "full oracle run",
-        "phase_seconds": {k: round(v, 3) for k, v in sorted(phases.items())},
-        "phase_shares": {
-            k: round(v / phase_total, 3) for k, v in sorted(phases.items())
-        },
+        "phase_seconds": seconds,
+        "phase_shares": shares,
+        # the odometer columns: iterations/pod is the pinned before-number
+        # the wave-packing PR's --check gate compares against
+        **odometer_row(odo, args.pods),
     }
 
     # merge-not-clobber: the default (headline-only) run updates its row

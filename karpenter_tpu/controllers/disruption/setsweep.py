@@ -100,7 +100,8 @@ last_search_stats: dict = {}
 def _set_sweep_kernel(
     tb, st, x, avail0, slot_cand, member, base_counts, percand_counts, sizes
 ):
-    """The removal-set sweep: feasible[B] for membership rows member
+    """The removal-set sweep: (feasible[B], odometer steps) for
+    membership rows member
     [B, J] (int32 0/1). slot_cand [E] maps existing slots to candidate
     indices (J = not a candidate); percand_counts [J, C] is the
     per-candidate class-count matrix P; base_counts [C] counts pods
@@ -297,14 +298,20 @@ class SetSweepContext:
         Jp = int(self.percand_counts.shape[0])
         padded = np.zeros((Bp, Jp), np.int32)
         padded[:B, : self.n_candidates] = member.astype(np.int32)
-        with tracing.span_of(trace, "dispatch", path="setsweep", lanes=B):
-            out = self._dispatch(jnp.asarray(padded))
-            feas = np.asarray(jax.device_get(out))[:B].astype(bool)
+        with tracing.span_of(
+            trace, "dispatch", path="setsweep", lanes=B
+        ) as dsp:
+            out, odo_steps = self._dispatch(jnp.asarray(padded))
+            out, odo_steps = jax.device_get((out, odo_steps))
+            feas = np.asarray(out)[:B].astype(bool)
+            dsp["kernel"] = {"steps": int(odo_steps), "lanes": B}
         if trace is not None:
             trace.count("dispatches")
             trace.count("set_lanes", by=B)
+            trace.count("kernel_iterations", by=int(odo_steps))
         tracing.SOLVE_DISPATCHES.inc({"path": "setsweep"})
         tracing.SWEEP_SET_LANES.inc(by=B)
+        tracing.KERNEL_ITERATIONS.inc({"path": "setsweep"}, by=int(odo_steps))
         return feas
 
     def _dispatch(self, member_dev):

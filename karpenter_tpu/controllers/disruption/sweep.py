@@ -84,7 +84,10 @@ def _ffd_feasibility_core(tb, rc, avail, counts, sizes):
     per-lane availability `avail` [B, E, R] (-1 marks a removed slot) and
     per-lane valid-pod counts `counts` [B, C] over the contiguous class
     sequence (sizes [C, R]), run the class-cumsum FFD identity and the
-    <=1-new-claim check, returning feasible [B].
+    <=1-new-claim check, returning (feasible [B], steps i32) — `steps`
+    is the kernel-odometer count of class-scan body trips (the device
+    loop iterations this dispatch executed; write-only, so the
+    feasibility verdicts are byte-identical with it carried).
 
     How the lanes were derived is the caller's business: the prefix
     kernel below compares candidate indices against the lane index, the
@@ -101,7 +104,8 @@ def _ffd_feasibility_core(tb, rc, avail, counts, sizes):
     INF = jnp.int32(1 << 30)
     ok_e = rc.ok_e  # [E] — static screen, same for every class (one rclass)
 
-    def body(avail, c):
+    def body(carry, c):
+        avail, steps = carry
         s = sizes[c]  # [R]
         per = jnp.where(
             (s > 0)[None, None, :], avail // jnp.maximum(s, 1)[None, None, :], INF
@@ -114,9 +118,11 @@ def _ffd_feasibility_core(tb, rc, avail, counts, sizes):
         take = jnp.clip(counts[:, c][:, None] - before, 0, cap)
         avail = avail - take[..., None] * s[None, None, :]
         left_c = counts[:, c] - take.sum(axis=1)
-        return avail, left_c
+        return (avail, steps + 1), left_c
 
-    avail, leftT = jax.lax.scan(body, avail, jnp.arange(C))
+    (avail, steps), leftT = jax.lax.scan(
+        body, (avail, jnp.zeros((), jnp.int32)), jnp.arange(C)
+    )
     left = leftT.T  # [B, C] — pods that fit no existing node
     tot = (left[:, :, None] * sizes[None]).sum(axis=1)  # [B, R]
     any_left = left.sum(axis=1) > 0
@@ -145,7 +151,7 @@ def _ffd_feasibility_core(tb, rc, avail, counts, sizes):
         )
     )(tstar, tot)
     claim_ok = has_t & fit_tot
-    return jnp.where(any_left, claim_ok, True)
+    return jnp.where(any_left, claim_ok, True), steps
 
 
 # graftlint: disable=dtype-overflow  (int64 worst-case guards live in the caller, _fast_prefix_feasibility; device math must stay int32)
@@ -168,6 +174,8 @@ def _fast_sweep_kernel(tb, st, x, avail0, cand_idx, counts, sizes, singleton=Fal
     class, and a single open claim stays compatible with every leftover
     pod — scheduler.go:488's existing→claim→new order reduces to
     "leftovers after existing nodes must fit the first workable template").
+
+    Returns (feasible [B], odometer steps) — see _ffd_feasibility_core.
     """
     import jax.numpy as jnp
 
@@ -299,8 +307,8 @@ def _fast_prefix_feasibility(
         )
     with tracing.span_of(
         trace, "dispatch", path="sweep_fast", lanes=len(candidates)
-    ):
-        feasible = _fast_sweep_cached(
+    ) as dsp:
+        feasible, odo_steps = _fast_sweep_cached(
             tb,
             base_st,
             x_row,
@@ -310,7 +318,12 @@ def _fast_prefix_feasibility(
             jnp.asarray(sizes),
             singleton=singleton,
         )
-        return [bool(v) for v in np.asarray(jax.device_get(feasible))]
+        feasible, odo_steps = jax.device_get((feasible, odo_steps))
+        dsp["kernel"] = {"steps": int(odo_steps)}
+        tracing.KERNEL_ITERATIONS.inc({"path": "sweep"}, by=int(odo_steps))
+        if trace is not None:
+            trace.count("kernel_iterations", by=int(odo_steps))
+        return [bool(v) for v in np.asarray(feasible)]
 
 
 class UnionSweep:
@@ -680,13 +693,20 @@ def _prefix_feasibility_traced(
             in_axes=(None, st_axes, xs_axes),
         )
     )
-    with tr.span("dispatch", path="sweep_vmap", lanes=B):
-        st_out, kinds, slots, over = sweep(tb, st_b, xs_b)
+    with tr.span("dispatch", path="sweep_vmap", lanes=B) as dsp:
+        st_out, kinds, slots, over, odo_b = sweep(tb, st_b, xs_b)
+        kinds, n_claims, over, odo_steps = jax.device_get(
+            (kinds, st_out.n_claims, over, odo_b.steps)
+        )
+        steps = int(np.asarray(odo_steps).sum())
+        dsp["kernel"] = {"steps": steps, "lanes": B}
+        tracing.KERNEL_ITERATIONS.inc({"path": "sweep"}, by=steps)
     tr.count("dispatches")
+    tr.count("kernel_iterations", by=steps)
     tracing.SOLVE_DISPATCHES.inc({"path": "sweep"})
-    kinds = np.asarray(jax.device_get(kinds))  # [B, P_pad]
-    n_claims = np.asarray(jax.device_get(st_out.n_claims))  # [B]
-    over = np.asarray(jax.device_get(over))
+    kinds = np.asarray(kinds)  # [B, P_pad]
+    n_claims = np.asarray(n_claims)  # [B]
+    over = np.asarray(over)
 
     feasible = []
     for k in range(B):

@@ -14,7 +14,13 @@ thread when `Options.probe_port` is set (port 0 picks a free one):
   telemetry ring (karpenter_tpu.tracing; docs/observability.md). Always
   on: the ring + phase histograms are the default-cost telemetry tier.
 - /debug/solves/<id>  — the full phase waterfall of one trace; a wire
-  correlation id returns BOTH the client- and server-side halves.
+  correlation id returns BOTH the client- and server-side halves. An
+  unknown (or garbage) id answers 404 with a JSON error body — the
+  endpoint's content type never depends on whether the lookup hit.
+- /debug/programs     — the compiled-program cost catalog (solver/aot.py
+  aot_manifest.json): every AOT-prewarmed (entry x rung x relax) combo
+  with bucket signature, compile seconds, and XLA cost/memory analysis
+  (flops / bytes accessed / argument+output+temp bytes).
 
 When constructed with enable_profiling=True (operator.go:183 --enable-
 profiling gate) it additionally serves the pprof analogs from
@@ -117,7 +123,20 @@ class ProbeServer:
                     ident = self.path[len("/debug/solves/"):]
                     found = tracing.RING.find(ident)
                     if not found:
-                        self._reply(404, f"no trace {ident!r} in the ring")
+                        # a JSON 404 body for unknown AND garbage ids:
+                        # a dashboard polling a rotated-out trace id must
+                        # get machine-readable "gone", not a text/plain
+                        # surprise (ISSUE 15 satellite)
+                        self._reply(
+                            404,
+                            json.dumps(
+                                {
+                                    "error": "no trace with this id in the ring",
+                                    "id": ident,
+                                }
+                            ),
+                            ctype="application/json",
+                        )
                         return
                     # a wire id matches the client- AND server-side halves
                     # of one logical trace; the waterfall is the spans
@@ -125,6 +144,17 @@ class ProbeServer:
                     body = json.dumps(
                         {"id": ident, "traces": [t.to_dict() for t in found]}
                     )
+                    self._reply(200, body, ctype="application/json")
+                elif self.path == "/debug/programs":
+                    # compiled-program cost catalog (solver/aot.py): reads
+                    # the manifest only — never compiles in a handler
+                    try:
+                        from karpenter_tpu.solver import aot
+
+                        body = json.dumps(aot.program_catalog())
+                    except Exception as e:
+                        self._reply(503, f"catalog unavailable: {e}")
+                        return
                     self._reply(200, body, ctype="application/json")
                 elif self.path.startswith("/debug/pprof/") and profiling_on:
                     from urllib.parse import parse_qs, urlparse

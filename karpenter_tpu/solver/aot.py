@@ -70,6 +70,70 @@ def manifest_path(cache_dir: str) -> str:
     return os.path.join(cache_dir, MANIFEST_NAME)
 
 
+# cost_analysis keys worth cataloging (the rest are per-op utilization
+# breakdowns whose naming churns across XLA versions)
+_COST_KEYS = ("flops", "bytes accessed", "transcendentals", "optimal_seconds")
+# memory_analysis attributes (XLA HLO buffer assignment totals) — the
+# capacity-planning numbers ROADMAP item 4 needs: how much HBM one
+# compiled program's arguments/outputs/temps pin per mesh shard
+_MEMORY_ATTRS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def _cost_blocks(compiled) -> tuple[dict, dict]:
+    """(cost, memory) dicts from one compiled executable. Best-effort by
+    design: cost_analysis()/memory_analysis() are backend-dependent (a
+    backend without them yields empty blocks, never a failed prewarm)."""
+    cost: dict = {}
+    memory: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        # older jax returns [dict] per computation; newer returns a dict
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        for k in _COST_KEYS:
+            v = (ca or {}).get(k)
+            if isinstance(v, (int, float)):
+                cost[k.replace(" ", "_")] = float(v)
+    except Exception:  # pragma: no cover - backend-dependent surface
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for attr in _MEMORY_ATTRS:
+            v = getattr(ma, attr, None)
+            if isinstance(v, (int, float)):
+                memory[attr] = int(v)
+    except Exception:  # pragma: no cover - backend-dependent surface
+        pass
+    return cost, memory
+
+
+def program_catalog(cache_dir: Optional[str] = None) -> dict:
+    """The compiled-program cost catalog `/debug/programs` serves: every
+    AOT-prewarmed (entry x rung x relax) combo with its bucket signature,
+    compile seconds, and the XLA cost/memory analysis captured at compile
+    time (flops / bytes accessed / argument+output+temp HBM bytes) —
+    the capacity-planning input for sizing a mesh (ROADMAP item 4).
+    Reads the manifest only; never compiles, never imports jax when the
+    cache is already configured."""
+    if cache_dir is None:
+        from karpenter_tpu.jaxsetup import ensure_compilation_cache
+
+        cache_dir = ensure_compilation_cache()
+    manifest = load_manifest(cache_dir)
+    return {
+        "cache_dir": cache_dir,
+        "jax": manifest.get("jax"),
+        "backend": manifest.get("backend"),
+        "programs": manifest.get("combos", {}),
+    }
+
+
 def load_manifest(cache_dir: Optional[str]) -> dict:
     """The ladder manifest, or an empty shell when absent/corrupt (a
     half-written manifest from a killed prewarm must read as 'nothing
@@ -221,11 +285,17 @@ def prewarm(
     compiled = skipped = 0
     PREWARM_READY.set(0.0)
 
-    def record(name: str, sig, seconds: float) -> None:
-        combos[name] = {
+    def record(name: str, sig, seconds: float, exe=None) -> None:
+        entry = {
             "signature": [list(x) for x in sig],
             "seconds": round(seconds, 3),
         }
+        # cost catalog (ISSUE 15): flops/bytes/HBM per compiled program,
+        # captured at the only moment the executable object is in hand
+        cost, memory = _cost_blocks(exe) if exe is not None else ({}, {})
+        entry["cost"] = cost
+        entry["memory"] = memory
+        combos[name] = entry
         if cache_dir:
             _write_manifest(cache_dir, manifest)
 
@@ -233,9 +303,13 @@ def prewarm(
         nonlocal compiled, skipped
         if stop is not None and stop.is_set():
             raise InterruptedError("prewarm stopped")
-        if name in reusable and combos[name].get("signature") == [
-            list(x) for x in sig
-        ]:
+        if (
+            name in reusable
+            and combos[name].get("signature") == [list(x) for x in sig]
+            # a pre-catalog manifest entry (no cost block) recompiles
+            # once so /debug/programs fills in; after that it skips again
+            and "cost" in combos[name]
+        ):
             # the executable is already persisted FOR THIS bucket
             # signature: skip even the trace (a warm service restart
             # prewarms in seconds, not minutes). A signature mismatch —
@@ -243,11 +317,11 @@ def prewarm(
             skipped += 1
             return
         t = time.monotonic()
-        fn()
+        exe = fn()
         dt = time.monotonic() - t
         compiled += 1
         PREWARM_PROGRAMS.inc({"entry": name.split("@", 1)[0]})
-        record(name, sig, dt)
+        record(name, sig, dt, exe=exe)
         if progress is not None:
             progress(name, dt)
         log.info("prewarmed", entry=name, seconds=round(dt, 2))

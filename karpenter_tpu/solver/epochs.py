@@ -61,6 +61,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
@@ -102,6 +103,18 @@ TABLE_CACHE = metrics.REGISTRY.counter(
     "Device-table cache lookups on the solve upload path, by outcome "
     "(hit skips the per-class table upload entirely).",
     ("outcome",),
+)
+ADMISSION_EWMA = metrics.REGISTRY.gauge(
+    "karpenter_admission_ewma_solve_seconds",
+    "AdmissionGate's EWMA of observed solve wall-clock — the per-request "
+    "cost floor the byte estimator is maxed against (0 until the first "
+    "completed solve feeds observe()).",
+)
+TABLE_CACHE_WAIT = metrics.REGISTRY.histogram(
+    "karpenter_table_cache_wait_seconds",
+    "Seconds a solve spent blocked on another lane's single-flight "
+    "device-table build (DeviceTableCache.begin_tables waiters only; "
+    "builders and resident hits never wait).",
 )
 
 
@@ -573,6 +586,7 @@ class DeviceTableCache:
         waited — re-check get_tables, and on a publish failure build
         anyway. The event wait happens OUTSIDE the lock (leaf-lock
         contract, graftlint race tier)."""
+        waited_since = None
         while True:
             with self._lock:
                 tb = self._tables.get(table_key)
@@ -582,10 +596,22 @@ class DeviceTableCache:
                     ev = self._building.get(table_key)
                     if ev is None:
                         self._building[table_key] = threading.Event()
-                        return None, table_key
+                        elected = True
+                    else:
+                        elected = False
+            if tb is None and elected:
+                # waited on a build that failed to publish, then won the
+                # re-election: the wait still happened — record it
+                if waited_since is not None:
+                    TABLE_CACHE_WAIT.observe(time.monotonic() - waited_since)
+                return None, table_key
             if tb is not None:
                 TABLE_CACHE.inc({"outcome": "tables_hit"})
+                if waited_since is not None:
+                    TABLE_CACHE_WAIT.observe(time.monotonic() - waited_since)
                 return tb, None
+            if waited_since is None:
+                waited_since = time.monotonic()
             if not ev.wait(self.BUILD_WAIT_SECONDS):
                 # builder thread destroyed mid-upload: evict the stale
                 # election (if it is still ours) so the KEY recovers —
@@ -596,6 +622,7 @@ class DeviceTableCache:
                     if self._building.get(table_key) is ev:
                         del self._building[table_key]
                 ev.set()
+                TABLE_CACHE_WAIT.observe(time.monotonic() - waited_since)
                 return None, None
 
     def end_tables(self, token, tb) -> None:
@@ -673,6 +700,11 @@ class AdmissionGate:
                 self._ewma_seconds = s
             else:
                 self._ewma_seconds = 0.8 * self._ewma_seconds + 0.2 * s
+            ewma = self._ewma_seconds
+        # export outside the lock (leaf-lock discipline): the EWMA used
+        # to be invisible — an operator could not tell WHY the gate
+        # started rejecting after one slow solve
+        ADMISSION_EWMA.set(ewma)
 
     def try_admit(self, payload_len: int):
         """(token, hint_seconds, depth): token is None on rejection, with

@@ -187,8 +187,9 @@ def fleet_fn(relax: bool):
 
 def fleet_dispatch(tb, st_b, xs_b, relax: bool = True):
     """ONE device dispatch running every stacked lane's solve step
-    batch; returns (st_b, kinds_b, slots_b, over_b) with a leading lane
-    axis (over_b is per lane — solve_scan's any-overflow scalar, mapped).
+    batch; returns (st_b, kinds_b, slots_b, over_b, odo_b) with a
+    leading lane axis (over_b is per lane — solve_scan's any-overflow
+    scalar, mapped; odo_b the per-lane kernel odometer block).
     Counted under the existing per-dispatch accounting as path=fleet."""
     out = fleet_fn(relax)(tb, st_b, xs_b)
     tracing.SOLVE_DISPATCHES.inc({"path": "fleet"})
@@ -208,7 +209,7 @@ class _Lane:
         "sched", "problem", "tb", "order", "N", "relax", "deadline",
         "trace", "done", "result", "error", "entered_at",
         "st", "kinds", "slots", "pending", "finished", "timed_out",
-        "solo", "rounds", "lanes_in_window", "epoch_key",
+        "solo", "rounds", "lanes_in_window", "epoch_key", "odo",
     )
 
     def __init__(self, sched, problem, tb, order, N, relax, deadline, trace):
@@ -234,6 +235,9 @@ class _Lane:
         self.rounds = 0
         self.lanes_in_window = 1
         self.epoch_key = None
+        # per-lane kernel-odometer accumulation across shared rounds
+        # (tpu.py folds it into the request's last_odometer/metrics)
+        self.odo = {"steps": 0, "tier_steps": 0, "tier_hist": [], "dispatches": 0}
 
 
 class _Window:
@@ -290,8 +294,9 @@ class FleetCoalescer:
     ):
         """Offer one scan-path solve to the current batch window.
 
-        Returns (st, kinds, slots, timed_out) — the same tuple the solo
-        scan loop produces, ready for `TpuScheduler._decode` — or None
+        Returns (st, kinds, slots, timed_out, odo) — the solo scan
+        loop's tuple plus this lane's accumulated kernel-odometer dict,
+        ready for `TpuScheduler._decode` — or None
         when the lane must run the solo path instead (no sibling
         arrived, claim-slot overflow, lane-local or batch-wide failure).
         Never raises for coalescing-machinery faults: the solo path is
@@ -519,11 +524,11 @@ class FleetCoalescer:
             # launch order alone does not prevent rendezvous interleaving
             # on backends that overlap execution
             with _MESH_DISPATCH_LOCK if sharded else contextlib.nullcontext():
-                st_b, kinds_b, slots_b, over_b = fleet_dispatch(
+                st_b, kinds_b, slots_b, over_b, odo_b = fleet_dispatch(
                     tb, st_b, xs_b, relax=relax
                 )
-                kinds_b, slots_b, over_b = jax.device_get(
-                    (kinds_b, slots_b, over_b)
+                kinds_b, slots_b, over_b, odo_b = jax.device_get(
+                    (kinds_b, slots_b, over_b, odo_b)
                 )
                 if sharded:
                     # the carried state is consumed NEXT round by another
@@ -535,6 +540,17 @@ class FleetCoalescer:
                 first_round = False
             for i, l in enumerate(ok):
                 l.rounds += 1
+                # this lane's slice of the per-lane odometer block (its
+                # own scan steps / tier trips — pad lanes' work is the
+                # replicated lane 0's and is charged to nobody)
+                l.odo["steps"] += int(odo_b.steps[i])
+                l.odo["tier_steps"] += int(odo_b.tier_steps[i])
+                hist = [int(v) for v in np.asarray(odo_b.tier_hist[i])]
+                if not l.odo["tier_hist"]:
+                    l.odo["tier_hist"] = [0] * len(hist)
+                for t, v in enumerate(hist):
+                    l.odo["tier_hist"][t] += v
+                l.odo["dispatches"] += 1
                 l.st = jax.tree_util.tree_map(
                     lambda a, i=i: a[i], st_b
                 )
@@ -563,7 +579,7 @@ class FleetCoalescer:
             if l.error is not None or l.solo:
                 l.result = None
             else:
-                l.result = (l.st, l.kinds, l.slots, l.timed_out)
+                l.result = (l.st, l.kinds, l.slots, l.timed_out, l.odo)
 
     @staticmethod
     def _gather(l: _Lane, P0: int):

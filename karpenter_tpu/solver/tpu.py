@@ -338,6 +338,69 @@ def _slice_rows(compact, u2: int):
     return _slice_rows_cached(compact, u2=u2)
 
 
+def _odo_dispatch_dict(odo) -> dict:
+    """One fetched kernel Odometer (tpu_kernel.Odometer of host arrays)
+    as plain ints — the `kernel` block a dispatch span carries and the
+    unit the per-solve totals accumulate."""
+    hist = [int(v) for v in np.asarray(odo.tier_hist)]
+    d = {
+        "steps": int(odo.steps),
+        "bulk_steps": int(odo.bulk_steps),
+        "tier_steps": int(odo.tier_steps),
+    }
+    if d["tier_steps"]:
+        d["tier_hist"] = hist
+    return d
+
+
+def _new_odo_totals() -> dict:
+    """Per-solve kernel-odometer accumulator (TpuScheduler.last_odometer).
+    Dispatch counters sum across every kernel launch of the solve —
+    including a scan-path overflow attempt that was later re-solved: the
+    odometer reports device work actually executed, not just the work
+    that survived. claims_opened / claim_slots / occupancy land in
+    _decode (they are final-state facts, not per-dispatch deltas)."""
+    from karpenter_tpu.solver import tpu_kernel as K
+
+    return {
+        "steps": 0,
+        "bulk_steps": 0,
+        "tier_steps": 0,
+        "tier_hist": [0] * K.ODO_TIER_BINS,
+        "dispatches": 0,
+        "overflow_signals": 0,
+        "regrows": 0,
+    }
+
+
+def _fold_odo_totals(totals: dict, d: dict, path: str) -> None:
+    """Fold one odometer dict — a dispatch's fetched block or a fleet
+    lane's accumulation — into the solve totals and the labeled kernel
+    metrics. ONE implementation for both paths, so their accounting
+    cannot drift."""
+    from karpenter_tpu import tracing
+
+    totals["steps"] += d.get("steps", 0)
+    totals["bulk_steps"] += d.get("bulk_steps", 0)
+    totals["tier_steps"] += d.get("tier_steps", 0)
+    for t, v in enumerate(d.get("tier_hist", ())):
+        totals["tier_hist"][t] += v
+    totals["dispatches"] += d.get("dispatches", 1)
+    if d.get("steps"):
+        tracing.KERNEL_ITERATIONS.inc({"path": path}, by=d["steps"])
+    for t, v in enumerate(d.get("tier_hist", ())):
+        if v:
+            tracing.KERNEL_TIER_STEPS.inc({"tier": str(t)}, by=v)
+
+
+def _record_odo_dispatch(totals: dict, odo, path: str) -> dict:
+    """Fold one dispatch's fetched odometer into the solve totals and the
+    labeled kernel metrics; returns the dispatch's `kernel` span block."""
+    d = _odo_dispatch_dict(odo)
+    _fold_odo_totals(totals, d, path)
+    return d
+
+
 def _tree_nbytes(tree) -> int:
     """Total bytes across a pytree of device arrays — the table-upload
     accounting behind karpenter_solve_upload_bytes_total (CLAUDE.md: the
@@ -519,6 +582,9 @@ class TpuScheduler:
         # waterfall shows WHICH epochs shared one materialization
         self._epoch_key = epoch_key
         self.last_used_fleet = False
+        # device-truth kernel odometer of the most recent solve (dict; see
+        # _new_odo_totals) — populated per solve, finished in _decode
+        self.last_odometer = None
 
     # -- solve ----------------------------------------------------------
 
@@ -655,6 +721,13 @@ class TpuScheduler:
         if tiers_beyond_0:
             prof.count("relax_tiers", by=tiers_beyond_0)
             tracing.SOLVE_RELAX_TIERS.inc(by=tiers_beyond_0)
+        # kernel odometers (device-truth counters): every dispatch below
+        # returns its counter block in the SAME fetch; totals accumulate
+        # here and finish in _decode (claims_opened / occupancy need the
+        # final state). Discarded overflow attempts still count — the
+        # odometer reports work executed, not work kept.
+        odo_totals = _new_odo_totals()
+        self.last_odometer = odo_totals
         # Fleet coalescing (solver/fleet.py): scan-path solves offer
         # themselves to the batch window; when siblings stack, the whole
         # requeue-round loop below runs inside ONE shared vmapped dispatch
@@ -673,8 +746,15 @@ class TpuScheduler:
                 table_fp=tfp, epoch_key=self._epoch_key,
             )
             if got is not None:
-                st, kinds, slots, timed_out = got
+                st, kinds, slots, timed_out, lane_odo = got
                 self.last_used_fleet = True
+                if lane_odo is not None:
+                    _fold_odo_totals(odo_totals, lane_odo, "fleet")
+                    prof.count("kernel_iterations", by=lane_odo.get("steps", 0))
+                    if lane_odo.get("tier_steps"):
+                        prof.count(
+                            "kernel_tier_steps", by=lane_odo["tier_steps"]
+                        )
                 prof.annotate(
                     pods=len(pods), path="fleet", relax=relax,
                     claim_slots=N, timed_out=timed_out,
@@ -702,10 +782,12 @@ class TpuScheduler:
                 while True:
                     batch = pending[offset:]
                     # one device dispatch: upload the round's index array,
-                    # run the kernel, fetch the verdicts. The pod_xs/
-                    # kernel/fetch sub-spans are per-dispatch detail —
-                    # individually recorded only behind the profiling gate
-                    with prof.span("dispatch", path=path):
+                    # run the kernel, fetch the verdicts + the kernel's
+                    # odometer block (same fetch — zero extra dispatches).
+                    # The pod_xs/kernel/fetch sub-spans are per-dispatch
+                    # detail — individually recorded only behind the
+                    # profiling gate
+                    with prof.span("dispatch", path=path) as dsp:
                         if use_runs:
                             with prof.span("pod_xs", detail=True):
                                 xs, idx_d, n_d = self._pod_xs_with_idx(problem, batch)
@@ -713,40 +795,51 @@ class TpuScheduler:
                             with prof.span("kernel", detail=True):
                                 (
                                     st, seq, next_seq, got_kinds, got_slots,
-                                    got_over, iters, got_ptr,
+                                    got_over, got_odo, got_ptr,
                                 ) = KR.solve_runs(
                                     tb, st, rx, seq, next_seq,
                                     jax.numpy.int32(len(batch)),
                                     relax=relax,
                                 )
-                            self.last_iters = iters
                         else:
                             with prof.span("pod_xs", detail=True):
                                 xs = self._pod_xs(problem, batch)
                             with prof.span("kernel", detail=True):
-                                st, got_kinds, got_slots, got_over = K.solve_scan(
-                                    tb, st, xs, relax=relax
-                                )
+                                (
+                                    st, got_kinds, got_slots, got_over,
+                                    got_odo,
+                                ) = K.solve_scan(tb, st, xs, relax=relax)
                                 got_ptr = None
                         # one batched device->host fetch (the tunnel
                         # charges per call)
                         with prof.span("fetch", detail=True):
                             fetched = jax.device_get(
-                                (got_kinds, got_slots, got_over)
+                                (got_kinds, got_slots, got_over, got_odo)
                                 if got_ptr is None
-                                else (got_kinds, got_slots, got_over, got_ptr)
+                                else (
+                                    got_kinds, got_slots, got_over, got_odo,
+                                    got_ptr,
+                                )
                             )
+                        dsp["kernel"] = _record_odo_dispatch(
+                            odo_totals, fetched[3], path
+                        )
                     prof.count("dispatches")
                     tracing.SOLVE_DISPATCHES.inc({"path": path})
                     got_kinds, got_slots, got_over = fetched[:3]
                     if bool(got_over) and got_ptr is None:
-                        overflowed = True  # scan path: re-solve from scratch
+                        # scan path: re-solve from scratch
+                        overflowed = True
+                        odo_totals["overflow_signals"] += 1
+                        tracing.KERNEL_OVERFLOWS.inc({"path": path})
                         break
                     if bool(got_over):
                         # runs path: commit everything before the overflow
                         # pod, pad the state with fresh slots, continue the
                         # round from that pod
-                        n_done = int(fetched[3])
+                        odo_totals["overflow_signals"] += 1
+                        tracing.KERNEL_OVERFLOWS.inc({"path": path})
+                        n_done = int(fetched[4])
                         done = batch[:n_done]
                         kinds[done] = got_kinds[:n_done]
                         slots[done] = got_slots[:n_done]
@@ -758,6 +851,7 @@ class TpuScheduler:
                             st, seq = self._grow(problem, st, seq, N)
                         prof.count("regrows")
                         tracing.SOLVE_REGROWS.inc()
+                        odo_totals["regrows"] += 1
                         N *= 2
                         offset += n_done
                         continue
@@ -778,6 +872,9 @@ class TpuScheduler:
                 break
             N *= 2  # scan-path slots exhausted: re-solve with room
 
+        prof.count("kernel_iterations", by=odo_totals["steps"])
+        if odo_totals["tier_steps"]:
+            prof.count("kernel_tier_steps", by=odo_totals["tier_steps"])
         prof.annotate(
             pods=len(pods), path=path, relax=relax, claim_slots=N,
             timed_out=timed_out,
@@ -1211,6 +1308,19 @@ class TpuScheduler:
         # byte. count/rank/topology stay behind entirely.
         n_claims = int(jax.device_get(st.n_claims))
         N = st.active.shape[0]
+        # finish the solve's odometer with the final-state facts: claim
+        # slots opened + high-water occupancy of the padded slot pool
+        # (claim_slot_div sizing feedback; ISSUE 15)
+        odo = getattr(self, "last_odometer", None)
+        if odo is not None:
+            from karpenter_tpu import tracing
+
+            odo["claims_opened"] = n_claims
+            odo["claim_slots"] = N
+            occupancy = (n_claims / N) if N else 0.0
+            odo["claim_occupancy"] = round(occupancy, 4)
+            tracing.KERNEL_CLAIMS_OPENED.inc(by=n_claims)
+            tracing.KERNEL_CLAIM_OCCUPANCY.observe(occupancy)
         n2 = min(_pow2(max(n_claims, 1), floor=64), N)
         E = st.eavail.shape[0]
         if n2 >= _DEDUP_DECODE_MIN:

@@ -61,6 +61,63 @@ KIND_CLAIM = 1
 KIND_NEW = 2
 KIND_FAIL = 3
 
+# relax-tier odometer bins: tier-loop trips at tier t land in bin
+# min(t, ODO_TIER_BINS - 1) — the last bin aggregates deeper rungs so the
+# counter block stays a fixed shape for every problem's ladder depth
+ODO_TIER_BINS = 8
+
+
+class Odometer(NamedTuple):
+    """Device-truth counters carried through the kernels and returned
+    alongside results (ISSUE 15). Strictly write-only inside the compiled
+    program: no decision ever reads a counter, so enabling/fetching them
+    cannot perturb parity (tests/test_tpu_parity.py odometer-inertness +
+    the fuzz invariant catalog pin this). All int32 — the totals are
+    bounded by pod counts x tier depth, far under 2^31.
+
+    - ``steps``: device loop iterations executed — lax.scan steps on the
+      scan path (pad positions included: padding costs real iterations),
+      while-loop body trips on the runs path. THE number wave packing
+      (ROADMAP item 1) must shrink; cross-checked against the IR tier's
+      static ``scan_total_length`` budget by the perf smoke test.
+    - ``bulk_steps``: runs-path bulk-phase trips (subset of ``steps``;
+      always 0 on the scan path).
+    - ``tier_steps``: relax tier-loop body trips (each trip runs one full
+      ``_step``) — the work multiplier relaxable batches pay; 0 when the
+      problem compiled the plain step.
+    - ``tier_hist``: [ODO_TIER_BINS] tier-loop trips by tier index;
+      sums to ``tier_steps``.
+    """
+
+    steps: jax.Array
+    bulk_steps: jax.Array
+    tier_steps: jax.Array
+    tier_hist: jax.Array
+
+
+def odometer_zero() -> Odometer:
+    return Odometer(
+        steps=jnp.zeros((), jnp.int32),
+        bulk_steps=jnp.zeros((), jnp.int32),
+        tier_steps=jnp.zeros((), jnp.int32),
+        tier_hist=jnp.zeros(ODO_TIER_BINS, jnp.int32),
+    )
+
+
+def odo_tier_tick(odo: Odometer, tiers) -> Odometer:
+    """Credit one pod's `tiers` tier-loop trips (trips at tier >=
+    ODO_TIER_BINS-1 aggregate into the last bin)."""
+    idx = jnp.arange(ODO_TIER_BINS, dtype=jnp.int32)
+    last = ODO_TIER_BINS - 1
+    inc = jnp.where(
+        idx < last,
+        (idx < tiers).astype(jnp.int32),
+        jnp.maximum(tiers - last, 0),
+    )
+    return odo._replace(
+        tier_steps=odo.tier_steps + tiers, tier_hist=odo.tier_hist + inc
+    )
+
 
 class Tables(NamedTuple):
     """Static (per-solve) device tensors."""
@@ -846,7 +903,12 @@ def _step_relax(tb: Tables, st: State, x: PodX):
     single-tier pod runs the body exactly once on its own rows — so the
     compiled program contains a single _step instance (the former
     cond(plain, tiers) duplicated the whole step and taxed mixed batches
-    with a branch per pod; VERDICT r4 #1)."""
+    with a branch per pod; VERDICT r4 #1).
+
+    Returns (state, out, tiers): `tiers` is the number of tier-loop body
+    trips this pod took — odometer food only (the drivers fold it into
+    Odometer.tier_hist); it is the loop's own counter, never a new
+    carry, so the budgeted carry bytes are unchanged."""
 
     def cond(c):
         t, done, _, _ = c
@@ -860,22 +922,37 @@ def _step_relax(tb: Tables, st: State, x: PodX):
         return (t + 1, done, st2, out)
 
     dummy = (jnp.int32(KIND_FAIL), jnp.int32(-1), jnp.zeros((), bool))
-    _, _, st2, out = jax.lax.while_loop(
+    tiers, _, st2, out = jax.lax.while_loop(
         cond, body, (jnp.int32(0), jnp.zeros((), bool), st, dummy)
     )
-    return st2, out
+    return st2, out, tiers
 
 
 @functools.partial(jax.jit, static_argnames=("relax",))
 def solve_scan(tb: Tables, st: State, xs: PodX, relax: bool = True):
     """Run the greedy pack over a pod batch; returns
-    (state, kinds, slots, overflowed) — overflowed means some pod failed
-    only because claim slots ran out (host should grow N and re-solve).
+    (state, kinds, slots, overflowed, odometer) — overflowed means some
+    pod failed only because claim slots ran out (host should grow N and
+    re-solve); `odometer` is this dispatch's device-truth counter block
+    (write-only: decisions never read it, so it is parity-inert).
 
     `relax` is trace-time static: problems with no relaxable requirement
     classes (every ntiers == 1) compile the plain `_step` with no tier
-    loop or branch — byte-equivalent to the pre-relaxation program, so
-    preference-free workloads pay nothing for the ladder machinery."""
-    step = functools.partial(_step_relax if relax else _step, tb)
-    st, (kinds, slots, overflow) = jax.lax.scan(step, st, xs)
-    return st, kinds, slots, jnp.any(overflow)
+    loop or branch — byte-equivalent to the pre-relaxation program (plus
+    the inert odometer carry), so preference-free workloads pay nothing
+    for the ladder machinery."""
+
+    def step(carry, x):
+        st, odo = carry
+        if relax:
+            st2, out, tiers = _step_relax(tb, st, x)
+            odo = odo_tier_tick(odo, tiers)
+        else:
+            st2, out = _step(tb, st, x)
+        odo = odo._replace(steps=odo.steps + 1)
+        return (st2, odo), out
+
+    (st, odo), (kinds, slots, overflow) = jax.lax.scan(
+        step, (st, odometer_zero()), xs
+    )
+    return st, kinds, slots, jnp.any(overflow), odo

@@ -320,8 +320,12 @@ def solve_runs(
     tb: Tables, st: State, rx: RunX, seq, next_seq, n_valid,
     relax: bool = True,
 ):
-    """Returns (state, seq, next_seq, kinds[P], slots[P], overflowed, iters).
-    Pods at index >= n_valid are shape padding and are never visited.
+    """Returns (state, seq, next_seq, kinds[P], slots[P], overflowed,
+    odometer, ptr). Pods at index >= n_valid are shape padding and are
+    never visited. `odometer` (tpu_kernel.Odometer) is this dispatch's
+    device-truth counter block — steps = while-loop trips (what wave
+    packing must shrink), bulk_steps the bulk-phase subset, tier counts
+    from the relax ladder; write-only, so decisions are unchanged.
     `relax` is trace-time static (see tpu_kernel.solve_scan): preference-
     free problems compile the plain exact step with no tier machinery."""
     P = rx.is_head.shape[0]
@@ -345,14 +349,17 @@ def solve_runs(
 
     # -- exact per-pod path (every run head; all pods of non-bulk classes)
     def single_step(carry):
-        st, rc, seq, nseq, ptr, kinds, slots, over = carry
+        st, rc, seq, nseq, ptr, kinds, slots, over, odo = carry
         x = xrow(ptr)
         # the seq key is a monotone transform of the rank order, and _step
         # only ever uses rank for min-selection (its rank updates are
         # discarded here), so the key substitutes directly — no sort
         st_in = st._replace(rank=_seq_key(st.count, seq, st.active))
-        step_fn = K._step_relax if relax else K._step
-        st2, (kind, slot, oflow) = step_fn(tb, st_in, x)
+        if relax:
+            st2, (kind, slot, oflow), tiers = K._step_relax(tb, st_in, x)
+            odo = K.odo_tier_tick(odo, tiers)
+        else:
+            st2, (kind, slot, oflow) = K._step(tb, st_in, x)
         joined = kind == KIND_CLAIM
         created = kind == KIND_NEW
         upd = joined | created
@@ -361,6 +368,7 @@ def solve_runs(
         nseq = nseq + upd.astype(jnp.int32)
         kinds = kinds.at[ptr].set(kind)
         slots = slots.at[ptr].set(slot)
+        odo = odo._replace(steps=odo.steps + 1)
         build = rx.bulk[ptr] & (rx.run_rem[ptr] > 1) & x.valid & ~oflow
         rc = jax.lax.cond(
             build,
@@ -373,13 +381,16 @@ def solve_runs(
         # solve with the pod wrongly unschedulable)
         return (
             st2, rc, seq, nseq, ptr + (~oflow).astype(jnp.int32),
-            kinds, slots, over | oflow,
+            kinds, slots, over | oflow, odo,
         )
 
     # -- bulk phases ------------------------------------------------------
 
     def bulk_step(carry):
-        st, rc, seq, nseq, ptr, kinds, slots, over = carry
+        st, rc, seq, nseq, ptr, kinds, slots, over, odo = carry
+        odo = odo._replace(
+            steps=odo.steps + 1, bulk_steps=odo.bulk_steps + 1
+        )
         x = xrow(ptr)
         rem = rx.run_rem[ptr]
         selv, selh, ownh = window_rows(ptr)
@@ -721,10 +732,10 @@ def solve_runs(
         )
         kinds = write_window(kinds, ptr, wk)
         slots = write_window(slots, ptr, ws)
-        return st2, rc2, seq2, nseq2, ptr + k, kinds, slots, over | oflow
+        return st2, rc2, seq2, nseq2, ptr + k, kinds, slots, over | oflow, odo
 
     def cond(carry):
-        (_, _, _, _, ptr, _, _, over), _ = carry
+        _, _, _, _, ptr, _, _, over, _ = carry
         # overflow stops the walk at the CURRENT pod: everything before
         # ptr is decided and N-invariant (slot count only gates creation),
         # so the host can pad the state to more slots and continue from
@@ -732,8 +743,7 @@ def solve_runs(
         return (ptr < n_valid) & ~over
 
     def body(carry):
-        inner, iters = carry
-        st, rc, seq, nseq, ptr, kinds, slots, over = inner
+        st, rc, seq, nseq, ptr, kinds, slots, over, odo = carry
         # non-affinity bulk heads build the cache up front and commit their
         # own pod through the bulk machinery — one heavy evaluation per run
         # instead of two (the exact step would redo it)
@@ -745,18 +755,19 @@ def solve_runs(
             lambda: _build_cache(tb, st, xrow(ptr)),
             lambda: rc,
         )
-        inner = (st, rc, seq, nseq, ptr, kinds, slots, over)
+        inner = (st, rc, seq, nseq, ptr, kinds, slots, over, odo)
         use_bulk = rc.active & rx.bulk[ptr] & (head_build | ~rx.is_head[ptr])
-        out = jax.lax.cond(use_bulk, bulk_step, single_step, inner)
-        return out, (iters[0] + 1, iters[1] + use_bulk.astype(jnp.int32))
+        return jax.lax.cond(use_bulk, bulk_step, single_step, inner)
 
     rc0 = _empty_cache(tb, st)
-    (st, rc, seq, next_seq, ptr, kinds, slots, over), iters = jax.lax.while_loop(
+    (
+        st, rc, seq, next_seq, ptr, kinds, slots, over, odo
+    ) = jax.lax.while_loop(
         cond,
         body,
         (
-            (st, rc0, seq, next_seq, jnp.int32(0), kinds0, slots0, jnp.zeros((), bool)),
-            (jnp.int32(0), jnp.int32(0)),
+            st, rc0, seq, next_seq, jnp.int32(0), kinds0, slots0,
+            jnp.zeros((), bool), K.odometer_zero(),
         ),
     )
-    return st, seq, next_seq, kinds[:P], slots[:P], over, iters, ptr
+    return st, seq, next_seq, kinds[:P], slots[:P], over, odo, ptr

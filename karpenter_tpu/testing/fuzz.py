@@ -913,11 +913,40 @@ def invariant_violations(case: FuzzCase, r: Results, pods) -> list[str]:
     return out
 
 
+def odometer_violations(h) -> list[str]:
+    """Invariant #7 (ISSUE 15, kernel odometers): a TPU-path solve must
+    leave a present, self-consistent device-truth counter block. The
+    inertness half of the contract is implicit and stronger: the
+    odometers are CARRIED on every dispatch the parity/invariant checks
+    above judge, so a counter that perturbed any decision (claims,
+    placements, errors) would fail those — this catches the counters
+    themselves going missing or inconsistent."""
+    tpu = getattr(h, "tpu", None)
+    if not getattr(h, "used_tpu", False) or tpu is None:
+        return []
+    odo = getattr(tpu, "last_odometer", None)
+    if odo is None:
+        return ["tpu-path solve left no kernel odometer"]
+    out: list[str] = []
+    if odo.get("dispatches", 0) < 1 or odo.get("steps", 0) < 1:
+        out.append(f"odometer empty after a tpu-path solve: {odo}")
+    if sum(odo.get("tier_hist", [])) != odo.get("tier_steps", 0):
+        out.append(f"odometer tier histogram != tier_steps total: {odo}")
+    if "claims_opened" in odo:
+        if not (0 <= odo["claims_opened"] <= odo.get("claim_slots", 0)):
+            out.append(f"odometer claim accounting out of range: {odo}")
+        if not (0.0 <= odo.get("claim_occupancy", 0.0) <= 1.0):
+            out.append(f"odometer claim occupancy out of [0,1]: {odo}")
+    if odo.get("bulk_steps", 0) > odo.get("steps", 0):
+        out.append(f"odometer bulk_steps exceeds steps: {odo}")
+    return out
+
+
 def check_invariants(case: FuzzCase) -> list[str]:
     """Invariant mode: solve through the production HybridScheduler and
     run the catalog on whatever came back."""
-    r, pods, _h = solve_hybrid(case)
-    return invariant_violations(case, r, pods)
+    r, pods, h = solve_hybrid(case)
+    return invariant_violations(case, r, pods) + odometer_violations(h)
 
 
 # ---------------------------------------------------------------------------

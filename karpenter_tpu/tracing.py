@@ -102,10 +102,50 @@ JAX_COMPILE_EVENTS = metrics.REGISTRY.counter(
     ("event",),
 )
 
+# -- kernel odometers (device-truth counters returned by each dispatch) ------
+
+KERNEL_ITERATIONS = metrics.REGISTRY.counter(
+    "karpenter_kernel_iterations_total",
+    "Device loop iterations executed inside kernel dispatches, by path "
+    "(runs/scan while-loop and scan steps, fleet = per-lane scan steps, "
+    "sweep/setsweep class-scan trips) — the odometer wave packing must "
+    "shrink.",
+    ("path",),
+)
+KERNEL_TIER_STEPS = metrics.REGISTRY.counter(
+    "karpenter_kernel_relax_tier_steps_total",
+    "Relax tier-loop body trips by tier index (each trip runs one full "
+    "kernel step; tier 7 aggregates deeper rungs).",
+    ("tier",),
+)
+KERNEL_CLAIMS_OPENED = metrics.REGISTRY.counter(
+    "karpenter_kernel_claims_opened_total",
+    "Fresh claim slots the kernel committed (device n_claims at decode).",
+)
+KERNEL_CLAIM_OCCUPANCY = metrics.REGISTRY.histogram(
+    "karpenter_kernel_claim_slot_occupancy",
+    "High-water claim-slot occupancy per solve (n_claims / padded slot "
+    "pool N after any regrows) — how tight claim_slot_div started.",
+    buckets=[0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0],
+)
+KERNEL_OVERFLOWS = metrics.REGISTRY.counter(
+    "karpenter_kernel_overflow_signals_total",
+    "Claim-slot overflow signals returned by kernel dispatches, by path "
+    "(runs: pad-and-continue regrow; scan: full re-solve at 2N).",
+    ("path",),
+)
+
 # spans recorded per trace before degrading to aggregate-only totals
 MAX_SPANS = 256
 # completed traces retained for /debug/solves
 RING_CAPACITY = 128
+
+RING_TRACES = metrics.REGISTRY.gauge(
+    "karpenter_trace_ring_traces",
+    "Completed traces currently held by the /debug/solves ring "
+    "(capacity RING_CAPACITY=128; pegged at capacity = oldest traces "
+    "are being evicted).",
+)
 
 # profiling gate: when off, detail=True spans fold into the per-phase
 # totals without an individual Span entry (ProbeServer flips this with
@@ -182,16 +222,23 @@ class Trace:
     # -- recording -------------------------------------------------------
 
     @contextlib.contextmanager
-    def span(self, name: str, detail: bool = False, **attrs: Any) -> Iterator[None]:
+    def span(self, name: str, detail: bool = False, **attrs: Any) -> Iterator[dict]:
         """Time the enclosed block as a phase. detail=True spans (the
         per-dispatch pod_xs/kernel/fetch sub-phases) still accumulate in
         the phase totals but only get an individual Span entry when the
-        profiling gate is on."""
+        profiling gate is on.
+
+        Yields the span's (mutable) attrs dict, so blocks whose facts
+        only exist at exit can attach them — the dispatch spans put the
+        fetched kernel-odometer block here (`attrs["kernel"] = {...}`)
+        and the /debug/solves waterfall shows device work per dispatch,
+        not just host wall-clock."""
+        attrs = dict(attrs)
         depth = self._depth
         self._depth = depth + 1
         start = time.monotonic()
         try:
-            yield
+            yield attrs
         finally:
             self._depth = depth
             dur = time.monotonic() - start
@@ -201,7 +248,7 @@ class Trace:
             if (not detail) or _DETAIL:
                 if len(self.spans) < MAX_SPANS:
                     self.spans.append(
-                        Span(name, start - self._t0, dur, depth, dict(attrs))
+                        Span(name, start - self._t0, dur, depth, attrs)
                     )
                 else:
                     self.truncated = True
@@ -307,6 +354,9 @@ class TraceRing:
     def push(self, trace: Trace) -> None:
         with self._lock:
             self._items.append(trace)
+            n = len(self._items)
+        # observe outside the lock (leaf-lock discipline, class docstring)
+        RING_TRACES.set(float(n))
 
     def snapshot(self) -> list[Trace]:
         with self._lock:
@@ -326,6 +376,7 @@ class TraceRing:
     def clear(self) -> None:
         with self._lock:
             self._items.clear()
+        RING_TRACES.set(0.0)
 
 
 RING = TraceRing()
@@ -356,9 +407,10 @@ def maybe_trace(trace: Optional[Trace], kind: str, side: str = "local") -> Itera
 
 
 def span_of(trace: Optional[Trace], name: str, detail: bool = False, **attrs: Any):
-    """trace.span(...) or a no-op context when no trace rides the call."""
+    """trace.span(...) or a no-op context when no trace rides the call
+    (the no-op still yields a throwaway dict so `as attrs` writes work)."""
     if trace is None:
-        return contextlib.nullcontext()
+        return contextlib.nullcontext({})
     return trace.span(name, detail=detail, **attrs)
 
 

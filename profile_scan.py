@@ -49,7 +49,7 @@ def main():
     print(f"compile+run: {t_compile:.1f}s")
 
     t0 = time.monotonic()
-    st2, kinds, slots, _over = K.solve_scan(tb, st, xs)
+    st2, kinds, slots, _over, _odo = K.solve_scan(tb, st, xs)
     jax.block_until_ready((st2, kinds, slots))
     t = time.monotonic() - t0
     kinds = np.asarray(kinds)

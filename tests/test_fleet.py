@@ -202,7 +202,7 @@ def test_fleet_core_matches_solo_solve_scan():
     ]
     refs = []
     for k in range(B):
-        st_k, kinds_k, slots_k, _ = jax.jit(K.solve_scan)(tb, st, xs_lanes[k])
+        st_k, kinds_k, slots_k, _, _ = jax.jit(K.solve_scan)(tb, st, xs_lanes[k])
         refs.append(
             (
                 int(st_k.n_claims),
@@ -212,7 +212,7 @@ def test_fleet_core_matches_solo_solve_scan():
         )
     st_b, xs_b = fleet.stack_lanes([st] * B, xs_lanes)
     st_b, xs_b = fleet.shard_lanes(st_b, xs_b)
-    st_f, kinds_f, slots_f, _ = fleet.fleet_dispatch(tb, st_b, xs_b)
+    st_f, kinds_f, slots_f, _, _ = fleet.fleet_dispatch(tb, st_b, xs_b)
     kinds_f = np.asarray(kinds_f)
     slots_f = np.asarray(slots_f)
     n_claims_f = np.asarray(st_f.n_claims)

@@ -34,14 +34,14 @@ def test_sharded_solve_scan_matches_unsharded(jax_mesh):
     tb, st, xs, _, _ = ge._small_problem(n_pods=16)
     assert st.active.shape[0] % 8 == 0
 
-    st_ref, kinds_ref, slots_ref, _ = jax.jit(K.solve_scan)(tb, st, xs)
+    st_ref, kinds_ref, slots_ref, _, _ = jax.jit(K.solve_scan)(tb, st, xs)
     kinds_ref, slots_ref = np.asarray(kinds_ref), np.asarray(slots_ref)
     # sanity: the problem actually schedules pods
     assert int(np.sum(kinds_ref != K.KIND_FAIL)) > 0
 
     tb_s, st_s, xs_s = ge.shard_problem(jax_mesh, tb, st, xs)
     with jax_mesh:
-        st_out, kinds, slots, _ = jax.jit(K.solve_scan)(tb_s, st_s, xs_s)
+        st_out, kinds, slots, _, _ = jax.jit(K.solve_scan)(tb_s, st_s, xs_s)
         jax.block_until_ready(st_out)
 
     assert np.array_equal(np.asarray(kinds), kinds_ref)

@@ -1,0 +1,219 @@
+"""Perf-regression sentinel (ISSUE 15): the bench.py --check gate's
+comparison logic, and the runtime-vs-static cross-check — a pinned
+problem's kernel-odometer iteration count must equal the IR tier's
+scan-length budget (kernel_budgets.json), so the two measurement tiers
+police each other.
+
+The check_regression tests are pure (synthetic rows, no jax); the
+odometer cross-check runs the real compiled kernel on the IR tier's own
+representative kit. A slow-marked subprocess test drives the full
+`bench.py --check --quick` CLI including the synthetically injected 2x
+phase-share regression (the acceptance pin)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import bench
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_ROW = {
+    "tpu_pods_per_sec": 1000.0,
+    "phase_shares": {
+        "dispatch": 0.60, "encode": 0.15, "decode": 0.10,
+        "upload": 0.08, "order": 0.04, "regrow": 0.01,
+    },
+    "kernel_iterations": 512,
+    "iterations_per_pod": 2.56,
+}
+
+
+def _current(**over):
+    cur = {
+        "tpu_pods_per_sec": 980.0,
+        "phase_shares": dict(BASELINE_ROW["phase_shares"]),
+        "kernel_iterations": 512,
+        "iterations_per_pod": 2.56,
+    }
+    cur.update(over)
+    return cur
+
+
+# ---------------------------------------------------------------------------
+# check_regression logic (pure)
+
+
+@pytest.mark.perf
+def test_check_passes_on_identical_measurement():
+    assert bench.check_regression(_current(), BASELINE_ROW) == []
+
+
+@pytest.mark.perf
+def test_check_passes_inside_tolerances():
+    cur = _current(tpu_pods_per_sec=700.0, iterations_per_pod=2.8)
+    cur["phase_shares"]["decode"] = 0.15  # 1.5x < 1.75x
+    assert bench.check_regression(cur, BASELINE_ROW) == []
+
+
+@pytest.mark.perf
+def test_throughput_drop_fails():
+    fails = bench.check_regression(
+        _current(tpu_pods_per_sec=500.0), BASELINE_ROW
+    )
+    assert any("throughput" in f for f in fails), fails
+
+
+@pytest.mark.perf
+def test_two_x_phase_share_regression_fails():
+    # the acceptance shape: one phase's share doubles
+    cur = _current()
+    cur["phase_shares"]["decode"] = 0.20
+    fails = bench.check_regression(cur, BASELINE_ROW)
+    assert any("phase share" in f and "decode" in f for f in fails), fails
+
+
+@pytest.mark.perf
+def test_tiny_phase_shares_are_noise_immune():
+    # regrow 0.01 -> 0.04 is 4x but under the 5% floor: never compared
+    cur = _current()
+    cur["phase_shares"]["regrow"] = 0.04
+    assert bench.check_regression(cur, BASELINE_ROW) == []
+
+
+@pytest.mark.perf
+def test_iteration_growth_fails_tight():
+    # iterations are deterministic: 20% growth must fail where the
+    # throughput band would have shrugged
+    fails = bench.check_regression(
+        _current(iterations_per_pod=3.1), BASELINE_ROW
+    )
+    assert any("iterations" in f for f in fails), fails
+
+
+@pytest.mark.perf
+def test_run_check_exit_codes():
+    code, report = bench.run_check(_current(), BASELINE_ROW, "quick_smoke")
+    assert code == 0 and report["ok"]
+    cur = _current()
+    cur["phase_shares"]["decode"] = 0.20
+    code, report = bench.run_check(cur, BASELINE_ROW, "quick_smoke")
+    assert code == 1 and not report["ok"] and report["failures"]
+    code, report = bench.run_check(_current(), None, "quick_smoke")
+    assert code == 2 and "error" in report
+
+
+@pytest.mark.perf
+def test_baseline_rows_missing_metrics_are_skipped_not_crashed():
+    # pre-odometer BENCH_DETAIL rows have no iterations_per_pod /
+    # phase_shares: the check compares what exists and passes the rest
+    assert bench.check_regression(
+        _current(), {"tpu_pods_per_sec": 1000.0}
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# runtime odometer vs static IR budget (the two tiers cross-check)
+
+
+@pytest.mark.perf
+def test_odometer_iterations_match_ir_scan_budget():
+    """The pinned generic kit (the SAME problem the graftlint IR tier
+    budgets) through the real compiled solve_scan: the runtime odometer's
+    executed-iteration count must equal the static jaxpr tier's
+    scan_total_length prediction in kernel_budgets.json. A drift in
+    either direction means one measurement layer is lying."""
+    from karpenter_tpu.analysis import ir
+    from karpenter_tpu.solver import tpu_kernel as K
+
+    with open(os.path.join(REPO_ROOT, "kernel_budgets.json")) as f:
+        budgets = json.load(f)["entries"]
+
+    kit = ir.build_kit("generic")
+    _st, kinds, _slots, over, odo = K.solve_scan(
+        kit.tb, kit.st, kit.xs, relax=False
+    )
+    predicted = budgets["solve_scan[relax=False]"]["metrics"][
+        "scan_total_length"
+    ]
+    assert int(odo.steps) == int(predicted), (
+        f"runtime odometer says {int(odo.steps)} scan iterations, the "
+        f"IR budget predicts {predicted}"
+    )
+    assert not bool(over)
+    # plain path: the tier machinery must report zero work
+    assert int(odo.tier_steps) == 0
+    assert int(odo.bulk_steps) == 0
+    import numpy as np
+
+    assert int(np.asarray(odo.tier_hist).sum()) == 0
+    # and the decisions that rode along are real (not a zeroed dummy)
+    assert int((np.asarray(kinds) != K.KIND_FAIL).sum()) > 0
+
+
+@pytest.mark.perf
+def test_odometer_relax_tier_accounting():
+    """The mixed kit through solve_scan(relax=True): tier trips must be
+    >= one per scan step (every pod pays at least tier 0) and the
+    histogram must sum to the total."""
+    import numpy as np
+
+    from karpenter_tpu.analysis import ir
+    from karpenter_tpu.solver import tpu_kernel as K
+
+    kit = ir.build_kit("mixed")
+    _st, _kinds, _slots, _over, odo = K.solve_scan(
+        kit.tb, kit.st, kit.xs, relax=True
+    )
+    steps = int(odo.steps)
+    tiers = int(odo.tier_steps)
+    assert steps == int(kit.xs.valid.shape[0])
+    assert tiers >= steps  # >= 1 tier trip per scan step
+    assert int(np.asarray(odo.tier_hist).sum()) == tiers
+    # tier 0 is attempted by every step
+    assert int(np.asarray(odo.tier_hist)[0]) == steps
+
+
+# ---------------------------------------------------------------------------
+# the full CLI, end to end (slow tier: subprocess measurement)
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+def test_bench_check_quick_cli_end_to_end(tmp_path):
+    """`bench.py --quick` pins a baseline row, `--check --quick` passes
+    against it, and the synthetically injected 2x phase-share regression
+    exits non-zero — the ISSUE 15 acceptance pin, against the real CLI.
+
+    Runs with cwd=tmp_path: BENCH_DETAIL.json is cwd-relative, so the
+    test's (pytest-contended — CLAUDE.md forbids benchmarking during a
+    pytest run) numbers land in a scratch file and the repo's committed
+    baseline is never touched."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "bench.py"), *args],
+            env=env, cwd=str(tmp_path), capture_output=True, text=True,
+            timeout=1200,
+        )
+
+    out = run("--quick")
+    assert out.returncode == 0, out.stderr[-2000:]
+    out = run("--check", "--quick", "--json")
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    report = json.loads(out.stdout)
+    assert report["ok"] and report["baseline_row"] == "quick_smoke"
+    out = run(
+        "--check", "--quick", "--inject-phase-regression", "dispatch:2.0"
+    )
+    assert out.returncode == 1, (out.returncode, out.stdout)
+    report = json.loads(out.stdout)
+    assert not report["ok"]
+    assert any("dispatch" in f for f in report["failures"])

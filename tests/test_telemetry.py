@@ -609,3 +609,188 @@ def test_metric_catalog_drift():
         f"docs/observability.md catalogs metrics no source registers: "
         f"{sorted(phantom)}"
     )
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: 404 JSON bodies, ring gauge, /debug/programs, kernel odometers
+
+
+def test_debug_solves_404_is_json_for_unknown_and_garbage_ids():
+    """Satellite: /debug/solves/<id> answers a machine-readable JSON 404
+    for unknown AND garbage ids — the content type never depends on
+    whether the lookup hit."""
+    tracing.RING.clear()
+    srv = ProbeServer(None, None)
+    srv.start()
+    try:
+        for ident in ("nosuch", "w999999", "../../etc", "a%20b", "", "9" * 64):
+            code, body = _get(srv, f"/debug/solves/{ident}")
+            assert code == 404, (ident, code)
+            got = json.loads(body)  # must parse as JSON
+            assert got["error"]
+            assert "id" in got
+    finally:
+        srv.stop()
+
+
+def test_trace_ring_occupancy_gauge():
+    """karpenter_trace_ring_traces tracks ring membership, pegging at
+    capacity when eviction starts — a saturated 128-trace ring is
+    visible instead of silently rotating."""
+    tracing.RING.clear()
+    assert tracing.RING_TRACES.value() == 0.0
+    for i in range(3):
+        t = tracing.new_trace("unit")
+        t.finish()
+    assert tracing.RING_TRACES.value() == 3.0
+    for _ in range(tracing.RING_CAPACITY + 10):
+        tracing.new_trace("unit").finish()
+    assert tracing.RING_TRACES.value() == float(tracing.RING_CAPACITY)
+    tracing.RING.clear()
+    assert tracing.RING_TRACES.value() == 0.0
+
+
+def test_debug_programs_serves_cost_catalog(monkeypatch, tmp_path):
+    """/debug/programs serves the AOT manifest's cost catalog: combos
+    with signature, compile seconds, and the cost/memory analysis blocks
+    captured at compile time."""
+    from karpenter_tpu.solver import aot
+
+    manifest = {
+        "version": aot.MANIFEST_VERSION,
+        "jax": "0.0-test",
+        "backend": "cpu",
+        "combos": {
+            "solve_scan[relax=False]@P=64,N=64": {
+                "signature": [["pods", 64]],
+                "seconds": 1.25,
+                "cost": {"flops": 123456.0, "bytes_accessed": 789.0},
+                "memory": {"argument_size_in_bytes": 4096,
+                           "temp_size_in_bytes": 512},
+            }
+        },
+    }
+    with open(tmp_path / aot.MANIFEST_NAME, "w") as f:
+        json.dump(manifest, f)
+    from karpenter_tpu import jaxsetup
+
+    monkeypatch.setattr(
+        jaxsetup, "ensure_compilation_cache", lambda: str(tmp_path)
+    )
+    srv = ProbeServer(None, None)
+    srv.start()
+    try:
+        code, body = _get(srv, "/debug/programs")
+        assert code == 200
+        got = json.loads(body)
+        combo = got["programs"]["solve_scan[relax=False]@P=64,N=64"]
+        assert combo["cost"]["flops"] == 123456.0
+        assert combo["memory"]["argument_size_in_bytes"] == 4096
+    finally:
+        srv.stop()
+
+
+def test_program_catalog_reads_manifest_directly(tmp_path):
+    from karpenter_tpu.solver import aot
+
+    manifest = {
+        "version": aot.MANIFEST_VERSION,
+        "jax": "0.0-test",
+        "backend": "cpu",
+        "combos": {"e@P=1": {"signature": [], "seconds": 0.1,
+                             "cost": {}, "memory": {}}},
+    }
+    with open(tmp_path / aot.MANIFEST_NAME, "w") as f:
+        json.dump(manifest, f)
+    got = aot.program_catalog(str(tmp_path))
+    assert got["backend"] == "cpu"
+    assert "e@P=1" in got["programs"]
+    # an empty/corrupt cache dir reads as an empty catalog, never raises
+    empty = aot.program_catalog(str(tmp_path / "nope"))
+    assert empty["programs"] == {}
+
+
+def test_dispatch_spans_carry_kernel_odometer_block():
+    """Tentpole: every solve dispatch span carries a `kernel` detail
+    block with the fetched odometer, and the trace counts record the
+    total — /debug/solves waterfalls show device work, not just host
+    time."""
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import TpuScheduler
+
+    tracing.RING.clear()
+    fixtures.reset_rng(11)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    pods = fixtures.make_diverse_pods(24)
+    topo = Topology(pools, {"default": its}, pods)
+    sched = TpuScheduler(pools, {"default": its}, topo)
+    sched.solve(pods)
+    tr = sched.last_profile
+    dispatch_spans = [s for s in tr.spans if s.name == "dispatch"]
+    assert dispatch_spans, [s.name for s in tr.spans]
+    blocks = [s.attrs.get("kernel") for s in dispatch_spans]
+    assert all(b is not None for b in blocks), blocks
+    assert sum(b["steps"] for b in blocks) == sched.last_odometer["steps"]
+    assert tr.counts.get("kernel_iterations") == sched.last_odometer["steps"]
+
+
+def test_kernel_metrics_accumulate_on_solve():
+    from karpenter_tpu.solver.topology import Topology
+    from karpenter_tpu.solver.tpu import TpuScheduler
+
+    fixtures.reset_rng(11)
+    its = construct_instance_types(sizes=[2, 8])
+    pools = [fixtures.node_pool(name="default")]
+    pods = fixtures.make_diverse_pods(24)
+    topo = Topology(pools, {"default": its}, pods)
+    sched = TpuScheduler(pools, {"default": its}, topo)
+    path_before = {
+        p: tracing.KERNEL_ITERATIONS.value({"path": p})
+        for p in ("runs", "scan")
+    }
+    claims_before = tracing.KERNEL_CLAIMS_OPENED.value()
+    occ_before = tracing.KERNEL_CLAIM_OCCUPANCY.count()
+    sched.solve(pods)
+    path = "runs" if sched.last_used_runs else "scan"
+    got = tracing.KERNEL_ITERATIONS.value({"path": path}) - path_before[path]
+    assert got == sched.last_odometer["steps"] > 0
+    assert (
+        tracing.KERNEL_CLAIMS_OPENED.value() - claims_before
+        == sched.last_odometer["claims_opened"]
+    )
+    assert tracing.KERNEL_CLAIM_OCCUPANCY.count() == occ_before + 1
+    lint_prometheus(metrics.REGISTRY.render())
+
+
+def test_admission_ewma_and_table_cache_wait_metrics():
+    """Satellite: the AdmissionGate EWMA and the DeviceTableCache
+    single-flight wait are exported (and survive the exposition lint)."""
+    from karpenter_tpu.solver import epochs
+
+    gate = epochs.AdmissionGate(max_inflight=2)
+    gate.observe(0.5)
+    assert epochs.ADMISSION_EWMA.value() == pytest.approx(0.5)
+    gate.observe(1.0)
+    assert epochs.ADMISSION_EWMA.value() == pytest.approx(0.6)
+
+    cache = epochs.DeviceTableCache()
+    waits_before = epochs.TABLE_CACHE_WAIT.count()
+    tb0, token = cache.begin_tables("fp1")
+    assert tb0 is None and token == "fp1"
+    got: list = []
+
+    def waiter():
+        tb, tok = cache.begin_tables("fp1")
+        got.append((tb, tok))
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    cache.end_tables(token, {"tables": True})
+    t.join(timeout=10)
+    assert got and got[0][0] == {"tables": True} and got[0][1] is None
+    assert epochs.TABLE_CACHE_WAIT.count() == waits_before + 1
+    # the waiter's blocked time is at least the builder's hold time
+    assert epochs.TABLE_CACHE_WAIT.sum() >= 0.1
+    lint_prometheus(metrics.REGISTRY.render())

@@ -46,13 +46,23 @@ CAPACITY = well_known.CAPACITY_TYPE_LABEL_KEY
 
 
 def run_parity(make, expect_errors=False):
-    """Solve via oracle and hybrid; assert identical partitions."""
+    """Solve via oracle and hybrid; assert identical partitions. Every
+    scenario also runs the kernel-odometer consistency catalog (ISSUE 15
+    — and since the counters ride every dispatch judged here, the
+    partition assertions below double as the odometer-inertness gate
+    across the whole matrix)."""
+    from karpenter_tpu.testing.fuzz import odometer_violations
+
     outs = []
+    hyb_sched = None
     for cls in (Scheduler, HybridScheduler):
         node_pools, its_by_pool, pods, views, daemons = make()
         topo = Topology(node_pools, its_by_pool, pods, state_node_views=views)
         s = cls(node_pools, its_by_pool, topo, views, daemons)
         outs.append((s.solve(pods), pods))
+        if cls is HybridScheduler:
+            hyb_sched = s
+    assert odometer_violations(hyb_sched) == []
     (orc, orc_pods), (hyb, hyb_pods) = outs
     orc_names = {p.uid: p.name for p in orc_pods}
     hyb_names = {p.uid: p.name for p in hyb_pods}
