@@ -654,7 +654,8 @@ for x in xs_lanes:
     jax.block_until_ready(solo_fn(tb, st, x)[0])
 st_b, xs_b = fleet.stack_lanes([st] * B, xs_lanes)
 st_b, xs_b = fleet.shard_lanes(st_b, xs_b)
-jax.block_until_ready(fleet.fleet_fn(True)(tb, st_b, xs_b)[0])
+fleet_fn = fleet.fleet_fn(True, sharded=fleet._mesh_active(B))
+jax.block_until_ready(fleet_fn(tb, st_b, xs_b)[0])
 N = cfg["kernel_reps"]
 t0 = time.monotonic()
 for _ in range(N):
@@ -664,7 +665,7 @@ for _ in range(N):
 t_solo = time.monotonic() - t0
 t0 = time.monotonic()
 for _ in range(N):
-    got = fleet.fleet_fn(True)(tb, st_b, xs_b)
+    got = fleet_fn(tb, st_b, xs_b)
 jax.block_until_ready(got[0])
 t_coal = time.monotonic() - t0
 out["kernel_lane_solves_per_sec"] = {

@@ -1,12 +1,13 @@
-"""graftlint — the three-tier invariant analyzer for this codebase.
+"""graftlint — the four-tier invariant analyzer for this codebase.
 
 The AST tier mechanically enforces the source-level architecture
 contracts documented in CLAUDE.md and the gate comments atop
 solver/tpu_runs.py: shared FFD comparator parity, kernel trace purity,
 int32-overflow guards in the consolidation sweep, integer milli-unit
 resources, lock discipline at the service boundary, `_ktpu_*` cache
-invalidation on relax mutations, reference citation hygiene, and pytest
-marker registration.
+invalidation on relax mutations, reference citation hygiene, pytest
+marker registration, and wire-codec enum coverage (every str-enum-typed
+api field registered in codec._ENUM_FIELDS).
 
 The IR tier (analysis/ir.py, `--ir`) traces the real solver kernels on
 small representative problems and walks the jaxprs: forbidden host
@@ -23,12 +24,22 @@ witness (analysis/racert.py) that instruments threading's locks under
 the fault-injection pytest suite and fails on observed lock-order
 inversions.
 
+The SPMD tier (analysis/spmd.py, `--spmd`) compiles the real solver
+programs — including the lane-sharded fleet entry on an 8-virtual-device
+mesh — and walks the compiled HLO / lowered StableHLO: a collective
+census pinned exact (zero everywhere today: GSPMD inserting a collective
+on the fleet axis means the lane axis leaked into a cross-device
+reduction), per-device HBM ceilings cross-checked against the
+aot_manifest.json cost catalog, a buffer-donation census, and the
+launch-lock AST rule (sharded dispatches inside `_MESH_DISPATCH_LOCK`
+with the result fetch).
+
 Importing THIS package MUST NOT import JAX or numpy
 (tests/test_static_analysis.py pins this) — the AST gate runs in seconds
-with no device/tunnel involvement; only analysis/ir.py imports JAX, and
-only when loaded explicitly (the CLI does so under `--ir`). The race
-tier's both halves are stdlib-only too (tests/test_race_analysis.py
-pins that).
+with no device/tunnel involvement; only analysis/ir.py and
+analysis/spmd.py import JAX, and only when loaded explicitly (the CLI
+does so under `--ir`/`--spmd`). The race tier's both halves are
+stdlib-only too (tests/test_race_analysis.py pins that).
 
 Usage:
     python -m karpenter_tpu.analysis            # AST: lint package + tests
@@ -36,6 +47,7 @@ Usage:
     python -m karpenter_tpu.analysis --changed-only   # pre-commit mode
     python -m karpenter_tpu.analysis --ir       # IR: trace kernels + budgets
     python -m karpenter_tpu.analysis --race     # race tier, static half
+    python -m karpenter_tpu.analysis --spmd     # SPMD: compile + census
     python -m karpenter_tpu.analysis --all      # every tier, worst exit code
 
 Rules, suppression syntax (`# graftlint: disable=<rule>`), the baseline

@@ -1,7 +1,7 @@
 """graftlint CLI: `python -m karpenter_tpu.analysis` (also installed as
 the `graftlint` console script).
 
-Three tiers share this entry point:
+Four tiers share this entry point:
 
 - the AST tier (default): stdlib-`ast` source analysis, JAX-free;
 - the IR tier (`--ir`): traces the real solver kernels and walks the
@@ -12,9 +12,16 @@ Three tiers share this entry point:
   locks.py) — acquisition-graph cycles, blocking calls under locks,
   thread-vs-public unguarded writes. JAX-free like the AST tier; the
   runtime half (analysis/racert.py) runs under pytest, not here.
+- the SPMD tier (`--spmd`): compiles the real solver programs —
+  including the lane-sharded fleet entry on an 8-virtual-device mesh —
+  and walks the compiled/StableHLO modules (analysis/spmd.py):
+  collective census, per-device HBM ceilings, donation census (the
+  `spmd:` half of kernel_budgets.json) plus the launch-lock AST rule.
+  The CLI pins the virtual mesh env BEFORE the first jax import.
 
-`--all` runs every tier (AST + race + IR) with merged `--json` output
-and a single worst-case exit code — the one-command CI gate.
+`--all` runs every tier (AST + race + IR + SPMD) with merged `--json`
+output, per-tier wall-clock seconds, and a single worst-case exit code
+— the one-command CI gate.
 
 Exit codes: 0 clean (baseline-covered findings allowed), 1 findings or
 stale/unjustified baseline or budget entries, 2 usage/parse/trace errors.
@@ -27,9 +34,11 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 from karpenter_tpu.analysis.engine import (
     IR_DEFAULT_BASELINE,
+    SPMD_DEFAULT_BASELINE,
     Baseline,
     all_rules,
     canonical_json,
@@ -199,10 +208,18 @@ def main(argv=None) -> int:
         "witness runs under pytest — see docs/static-analysis.md)",
     )
     parser.add_argument(
+        "--spmd",
+        action="store_true",
+        help="run the SPMD tier: compile the solver programs (incl. the "
+        "lane-sharded fleet entry on an 8-virtual-device mesh) and "
+        "enforce the collective/HBM/donation budgets plus the "
+        "launch-lock rule (imports JAX; see docs/static-analysis.md)",
+    )
+    parser.add_argument(
         "--all",
         action="store_true",
-        help="run every tier (AST + race + IR) with merged --json output "
-        "and a single worst-case exit code",
+        help="run every tier (AST + race + IR + SPMD) with merged --json "
+        "output, per-tier seconds, and a single worst-case exit code",
     )
     parser.add_argument(
         "--budgets",
@@ -222,11 +239,14 @@ def main(argv=None) -> int:
             print(f"{r.id:20s} {r.summary}")
         from karpenter_tpu.analysis.ir import IR_RULES
         from karpenter_tpu.analysis.locks import RACE_RULES
+        from karpenter_tpu.analysis.spmd import SPMD_RULES
 
         for rid, summary in IR_RULES.items():
             print(f"{rid:20s} [ir] {summary}")
         for rid, summary in RACE_RULES.items():
             print(f"{rid:20s} [race] {summary}")
+        for rid, summary in SPMD_RULES.items():
+            print(f"{rid:20s} [spmd] {summary}")
         return 0
 
     repo_root = os.path.abspath(args.root or _detect_repo_root())
@@ -237,8 +257,11 @@ def main(argv=None) -> int:
         flag
         for flag, on in (
             ("--all", args.all),
-            ("--ir", args.ir or args.write_budgets),
+            # --write-budgets without a tier flag keeps its historical
+            # meaning (--ir); under --spmd it rewrites the spmd: half
+            ("--ir", args.ir or (args.write_budgets and not args.spmd)),
             ("--race", args.race),
+            ("--spmd", args.spmd),
         )
         if on
     ]
@@ -246,12 +269,14 @@ def main(argv=None) -> int:
         print(
             "graftlint: " + " and ".join(picked) + " are mutually "
             "exclusive — pick one tier mode (--all runs every tier; "
-            "--write-budgets implies --ir)",
+            "--write-budgets alone implies --ir)",
             file=sys.stderr,
         )
         return 2
     if args.all:
         return _main_all(args, repo_root)
+    if args.spmd:
+        return _main_spmd(args, repo_root)
     if args.write_budgets:
         args.ir = True
     if args.ir:
@@ -277,7 +302,7 @@ def main(argv=None) -> int:
                 "graftlint: unknown rule id(s): "
                 + ", ".join(sorted(unknown))
                 + " (see --list-rules; ir-* rules need --ir, race-* "
-                "rules need --race)",
+                "rules need --race, spmd-* rules need --spmd)",
                 file=sys.stderr,
             )
             return 2
@@ -405,7 +430,11 @@ def _main_ir(args: argparse.Namespace, repo_root: str) -> int:
                 print(f"trace error: {e}", file=sys.stderr)
             return 2
         existing = budgets_mod.BudgetManifest.load(budgets_path)
-        data = budgets_mod.BudgetManifest.render(measured, existing)
+        # spmd_scope=False: carry the SPMD tier's `spmd:` entries over
+        # verbatim — an IR rewrite must not truncate the sibling tier
+        data = budgets_mod.BudgetManifest.render(
+            measured, existing, spmd_scope=False
+        )
         fresh = sum(
             1
             for e in data["entries"].values()
@@ -485,6 +514,161 @@ def _main_ir(args: argparse.Namespace, repo_root: str) -> int:
 
     if errors:
         # a kernel that no longer traces is a broken gate, not a lint
+        # verdict — exit 2 even when comparison findings also exist
+        return 2
+    if findings or stale or unjustified or budget_unjustified:
+        return 1
+    return 0
+
+
+def _main_spmd(args: argparse.Namespace, repo_root: str) -> int:
+    """The `--spmd` tier (analysis/spmd.py): compile the solver
+    programs, enforce the `spmd:` half of kernel_budgets.json, run the
+    launch-lock rule, apply graftlint.spmd.baseline.json."""
+    if args.paths or args.changed_only:
+        # SPMD rules compile kernel entry points (plus one fixed-scope
+        # AST rule) — a path subset has no meaning and must not read as
+        # a clean run
+        print(
+            "graftlint: --spmd compiles kernel entry points; it takes "
+            "no paths and no --changed-only",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        from karpenter_tpu.analysis import budgets as budgets_mod
+        from karpenter_tpu.analysis import spmd
+    except ImportError as e:
+        print(f"graftlint: SPMD tier unavailable ({e})", file=sys.stderr)
+        return 2
+    # the 8-virtual-device mesh env must be pinned before the first jax
+    # import or the lane-sharded fleet program cannot be compiled
+    spmd.ensure_host_devices()
+
+    rule_ids = (
+        {r.strip() for r in args.rules.split(",")} if args.rules else None
+    )
+    if rule_ids is not None:
+        # a typo'd id would intersect SPMD_RULES to the empty set: the
+        # tier would compile nothing and exit 0 — a silently disabled gate
+        unknown = rule_ids - set(spmd.SPMD_RULES)
+        if unknown:
+            print(
+                "graftlint: unknown SPMD rule id(s): "
+                + ", ".join(sorted(unknown))
+                + " (see --list-rules)",
+                file=sys.stderr,
+            )
+            return 2
+    budgets_path = args.budgets or os.path.join(
+        repo_root, budgets_mod.DEFAULT_MANIFEST
+    )
+    baseline_path = args.baseline or os.path.join(
+        repo_root, SPMD_DEFAULT_BASELINE
+    )
+    if not _json_files_parse(budgets_path, baseline_path):
+        return 2
+
+    if args.write_budgets:
+        if rule_ids is not None:
+            # a partial run measures a slice; rewriting from it would
+            # truncate every out-of-scope entry
+            print(
+                "graftlint: --write-budgets requires a full SPMD run "
+                "(no --rules)",
+                file=sys.stderr,
+            )
+            return 2
+        measured, _, errors, _ = spmd.measure(None)
+        if errors:
+            for e in errors:
+                print(f"compile error: {e}", file=sys.stderr)
+            return 2
+        existing = budgets_mod.BudgetManifest.load(budgets_path)
+        # spmd_scope=True: carry the IR tier's entries over verbatim —
+        # an SPMD rewrite must not truncate the sibling tier
+        data = budgets_mod.BudgetManifest.render(
+            measured, existing, spmd_scope=True
+        )
+        fresh = sum(
+            1
+            for e in data["entries"].values()
+            if str(e["justification"]).startswith("TODO")
+        )
+        with open(budgets_path, "w", encoding="utf-8") as f:
+            f.write(budgets_mod.BudgetManifest.dumps(data))
+        print(
+            f"graftlint: wrote {len(data['entries'])} budget entr"
+            f"{'y' if len(data['entries']) == 1 else 'ies'} to "
+            f"{budgets_path}"
+            + (f" — justify the {fresh} new one(s)" if fresh else "")
+        )
+        return 0
+
+    report = spmd.run_spmd_analysis(
+        repo_root,
+        budgets_path=budgets_path,
+        baseline_path=baseline_path,
+        rule_ids=rule_ids,
+    )
+
+    if args.write_baseline:
+        if rule_ids is not None:
+            # a partial run sees a slice of the findings; rewriting from
+            # it would truncate every out-of-scope curated entry
+            print(
+                "graftlint: --write-baseline under --spmd requires a "
+                "full SPMD run (no --rules)",
+                file=sys.stderr,
+            )
+            return 2
+        if report["errors"]:
+            # a partial measurement must never rewrite the baseline as if
+            # the errored program's findings were resolved
+            for e in report["errors"]:
+                print(f"compile error: {e}", file=sys.stderr)
+            return 2
+        return _write_baseline_file(baseline_path, report["all_findings"])
+
+    findings = report["findings"]
+    # partial runs (--rules) leave baseline entries for out-of-scope
+    # rules unmatched — expected, not staleness (the AST tier's subset
+    # convention); only the full run polices baseline rot
+    stale = [] if rule_ids is not None else report["stale"]
+    unjustified = report["unjustified"]
+    budget_unjustified = report["budget_unjustified"]
+    errors = report["errors"]
+
+    baselined = len(report["all_findings"]) - len(findings)
+    if args.json:
+        payload = _tier_payload(findings, stale, unjustified, errors, baselined)
+        payload["unjustified_budgets"] = budget_unjustified
+        payload["improvements"] = report["improvements"]
+        payload["measured"] = report["measured"]
+        print(json.dumps(payload, indent=2))
+    else:
+        _print_report_entries(findings, stale, unjustified)
+        for name in budget_unjustified:
+            print(
+                f"unjustified budget entry: {name}: add a one-line "
+                "justification in kernel_budgets.json"
+            )
+        for e in errors:
+            print(f"compile error: {e}")
+        print(
+            f"graftlint --spmd: {len(findings)} finding"
+            f"{'' if len(findings) == 1 else 's'}, "
+            f"{len(report['measured'])} program(s) compiled"
+            + (f", {baselined} baselined" if baselined else "")
+            + (
+                f", {len(report['improvements'])} budget(s) with slack"
+                if report["improvements"]
+                else ""
+            )
+        )
+
+    if errors:
+        # a program that no longer compiles is a broken gate, not a lint
         # verdict — exit 2 even when comparison findings also exist
         return 2
     if findings or stale or unjustified or budget_unjustified:
@@ -593,10 +777,10 @@ def _main_race(args: argparse.Namespace, repo_root: str) -> int:
 
 
 def _main_all(args: argparse.Namespace, repo_root: str) -> int:
-    """`--all`: AST + race + IR in one invocation, merged `--json`
-    output, worst-case exit code (2 > 1 > 0). Read-only by design — the
-    write modes stay per-tier so a rewrite is always an explicit,
-    single-tier act."""
+    """`--all`: AST + race + IR + SPMD in one invocation, merged
+    `--json` output with per-tier wall-clock seconds, worst-case exit
+    code (2 > 1 > 0). Read-only by design — the write modes stay
+    per-tier so a rewrite is always an explicit, single-tier act."""
     if (
         args.paths
         or args.changed_only
@@ -625,6 +809,7 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
         os.path.join(repo_root, "graftlint.baseline.json"),
         os.path.join(repo_root, locks.DEFAULT_BASELINE),
         os.path.join(repo_root, IR_DEFAULT_BASELINE),
+        os.path.join(repo_root, SPMD_DEFAULT_BASELINE),
     ]
     try:
         from karpenter_tpu.analysis import budgets as _budgets_preflight
@@ -636,6 +821,16 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
         pass  # IR tier will report itself unavailable below
     if not _json_files_parse(*gate_files):
         return 2
+
+    # the SPMD tier needs the 8-virtual-device mesh pinned BEFORE the
+    # first jax import — and the IR tier two blocks down is what
+    # performs that first import, so the pin happens here
+    try:
+        from karpenter_tpu.analysis import spmd as spmd_mod
+
+        spmd_mod.ensure_host_devices()
+    except ImportError:
+        spmd_mod = None  # the tier reports itself unavailable below
 
     payload: dict = {}
     codes: list[int] = []
@@ -652,6 +847,7 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
             return 2
         return 0
 
+    t0 = time.monotonic()
     ast_report = run_analysis(repo_root, reference_root=args.reference_root)
     codes.append(_tier_code(ast_report))
     payload["ast"] = _tier_payload(
@@ -662,7 +858,9 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
         ast_report["total"] - len(ast_report["findings"]),
     )
     payload["ast"]["exit_code"] = codes[-1]
+    payload["ast"]["seconds"] = round(time.monotonic() - t0, 3)
 
+    t0 = time.monotonic()
     race_report = locks.run_race_analysis(repo_root)
     # parse errors make the whole-program claim false: broken gate (2),
     # mirroring the IR tier's trace-error convention below
@@ -675,7 +873,9 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
         race_report["total"] - len(race_report["findings"]),
     )
     payload["race"]["exit_code"] = codes[-1]
+    payload["race"]["seconds"] = round(time.monotonic() - t0, 3)
 
+    t0 = time.monotonic()
     try:
         from karpenter_tpu.analysis import budgets as budgets_mod
         from karpenter_tpu.analysis import ir
@@ -709,13 +909,55 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
     except ImportError as e:
         codes.append(2)
         payload["ir"] = {"unavailable": str(e), "exit_code": 2}
+    payload["ir"]["seconds"] = round(time.monotonic() - t0, 3)
+
+    t0 = time.monotonic()
+    if spmd_mod is None:
+        codes.append(2)
+        payload["spmd"] = {
+            "unavailable": "karpenter_tpu.analysis.spmd failed to import",
+            "exit_code": 2,
+        }
+    else:
+        spmd_report = spmd_mod.run_spmd_analysis(
+            repo_root,
+            budgets_path=os.path.join(
+                repo_root, _budgets_preflight.DEFAULT_MANIFEST
+            ),
+            baseline_path=os.path.join(repo_root, SPMD_DEFAULT_BASELINE),
+        )
+        # mirror _main_spmd: a program that no longer compiles is a
+        # broken gate (2), even when comparison findings also exist
+        spmd_code = (
+            2
+            if spmd_report["errors"]
+            else _tier_code(
+                spmd_report,
+                extra_unjustified=len(spmd_report["budget_unjustified"]),
+            )
+        )
+        codes.append(spmd_code)
+        payload["spmd"] = _tier_payload(
+            spmd_report["findings"],
+            spmd_report["stale"],
+            spmd_report["unjustified"],
+            spmd_report["errors"],
+            len(spmd_report["all_findings"]) - len(spmd_report["findings"]),
+        )
+        payload["spmd"]["unjustified_budgets"] = spmd_report[
+            "budget_unjustified"
+        ]
+        payload["spmd"]["improvements"] = spmd_report["improvements"]
+        payload["spmd"]["measured"] = spmd_report["measured"]
+        payload["spmd"]["exit_code"] = spmd_code
+    payload["spmd"]["seconds"] = round(time.monotonic() - t0, 3)
 
     worst = max(codes)
     if args.json:
         payload["exit_code"] = worst
         print(json.dumps(payload, indent=2))
     else:
-        for tier in ("ast", "race", "ir"):
+        for tier in ("ast", "race", "ir", "spmd"):
             rep = payload[tier]
             if "unavailable" in rep:
                 print(f"[{tier}] unavailable: {rep['unavailable']}")
@@ -746,7 +988,7 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
                 + (f", {rep['baselined']} baselined" if rep["baselined"] else "")
                 + ("" if problems == len(rep["findings"]) else
                    f", {problems - len(rep['findings'])} baseline/budget problem(s)")
-                + f" (exit {rep['exit_code']})"
+                + f" ({rep['seconds']}s, exit {rep['exit_code']})"
             )
         print(f"graftlint --all: worst exit {worst}")
     return worst

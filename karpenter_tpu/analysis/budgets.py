@@ -33,6 +33,14 @@ from karpenter_tpu.analysis.engine import canonical_json
 
 DEFAULT_MANIFEST = "kernel_budgets.json"
 
+# The SPMD tier (analysis/spmd.py) shares this manifest file but owns a
+# disjoint namespace: its entries are prefixed `spmd:` and carry the
+# compiled-program metrics below. Each tier compares against a `scoped()`
+# view so the IR tier never reads SPMD entries as orphaned (and vice
+# versa), and `render(..., spmd_scope=...)` carries the other tier's
+# entries over verbatim on `--write-budgets`.
+SPMD_PREFIX = "spmd:"
+
 # metric name -> enforcement policy; a manifest metric outside this table
 # is reported as unknown (the manifest rotted or the tool regressed)
 METRIC_POLICY: dict[str, str] = {
@@ -82,6 +90,25 @@ METRIC_POLICY: dict[str, str] = {
     "fleet_repeat_window_dispatches": "exact",
     "fleet_repeat_window_traces": "exact",
     "fleet_repeat_window_compiles": "exact",
+    # SPMD tier (analysis/spmd.py, `spmd:`-prefixed entries): collective
+    # census of the compiled (post-GSPMD) program — exact, because a
+    # collective appearing where the budget pins zero is a sharding
+    # regression even when it is "only one" (the lane axis leaked into a
+    # cross-device reduction), and a collective DISAPPEARING from the
+    # slots/types path would mean the program stopped sharding at all
+    "collectives_all_gather": "exact",
+    "collectives_all_reduce": "exact",
+    "collectives_permute": "exact",
+    "collectives_other": "exact",
+    # donated/aliased inputs per program — exact-zero today; the carry-
+    # donation PR (ROADMAP item 1) must flip these budgets intentionally
+    "donated_args": "exact",
+    # per-device HBM from compiled.memory_analysis() — ceilings: the
+    # capacity numbers ROADMAP item 4 predicts from; growth is always a
+    # regression, shrinkage (donation landing, layout wins) is slack
+    "hbm_argument_bytes": "ceiling",
+    "hbm_output_bytes": "ceiling",
+    "hbm_temp_bytes": "ceiling",
 }
 
 
@@ -172,6 +199,20 @@ class BudgetManifest:
             data = json.load(f)
         return cls(dict(data.get("entries", {})), path)
 
+    def scoped(self, spmd: bool) -> "BudgetManifest":
+        """This tier's slice of the shared manifest: the SPMD tier owns
+        the `spmd:`-prefixed entries, the IR tier everything else. Each
+        tier compares against its own slice so the other tier's entries
+        never read as orphaned (compare() polices `entries - measured`)."""
+        return BudgetManifest(
+            {
+                name: e
+                for name, e in self.entries.items()
+                if name.startswith(SPMD_PREFIX) == spmd
+            },
+            self.path,
+        )
+
     def unjustified(self) -> list[str]:
         """Entry names whose justification is empty or a TODO placeholder
         (same policing as graftlint.baseline.json)."""
@@ -246,11 +287,30 @@ class BudgetManifest:
     def render(
         measured: dict[str, dict[str, int]],
         existing: Optional["BudgetManifest"] = None,
+        spmd_scope: Optional[bool] = None,
     ) -> dict:
         """Manifest dict for --write-budgets. Entries that already exist
         keep their hand-written justification (the --write-baseline
-        convention); genuinely new ones get the TODO placeholder."""
+        convention); genuinely new ones get the TODO placeholder.
+
+        `spmd_scope` names the tier doing the write (True: SPMD, False:
+        IR, None: legacy whole-file write): the OTHER tier's existing
+        entries are carried over verbatim, so a `--write-budgets` under
+        either tier can never truncate its sibling's half of the shared
+        file."""
         entries = {}
+        if spmd_scope is not None and existing is not None:
+            for name, e in existing.entries.items():
+                if name.startswith(SPMD_PREFIX) != spmd_scope:
+                    entries[name] = {
+                        "justification": str(e.get("justification", "")),
+                        "metrics": {
+                            m: int(v)
+                            for m, v in sorted(
+                                dict(e.get("metrics", {})).items()
+                            )
+                        },
+                    }
         for name in sorted(measured):
             old = (existing.entries.get(name) if existing else None) or {}
             entries[name] = {

@@ -62,6 +62,9 @@ class Finding:
 # to name the file without paying that import; locks.py keeps its own
 # DEFAULT_BASELINE the same way
 IR_DEFAULT_BASELINE = "graftlint.ir.baseline.json"
+# the SPMD tier's baseline, hoisted for the same reason (spmd.py
+# compiles real sharded programs and imports JAX)
+SPMD_DEFAULT_BASELINE = "graftlint.spmd.baseline.json"
 
 
 @dataclasses.dataclass
@@ -384,11 +387,12 @@ def all_rules() -> list[Rule]:
         rules_kernel,
         rules_metrics,
         rules_threads,
+        rules_wire,
     )
 
     rules: list[Rule] = []
     for mod in (rules_kernel, rules_data, rules_threads, rules_docs,
-                rules_metrics):
+                rules_metrics, rules_wire):
         rules.extend(r() for r in mod.RULES)
     return rules
 

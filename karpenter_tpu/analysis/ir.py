@@ -1036,7 +1036,11 @@ def run_ir_analysis(
         if baseline_path is not None
         else os.path.join(repo_root, IR_DEFAULT_BASELINE)
     )
-    manifest = budgets_mod.BudgetManifest.load(budgets_path)
+    # this tier owns the un-prefixed half of the shared manifest; the
+    # `spmd:` entries belong to analysis/spmd.py (budgets.SPMD_PREFIX)
+    manifest = budgets_mod.BudgetManifest.load(budgets_path).scoped(
+        spmd=False
+    )
     measured, findings, errors = measure(rule_ids)
     errored = {e.split(":", 1)[0] for e in errors}
     bfindings, improvements = budget_findings(

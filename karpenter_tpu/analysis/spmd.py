@@ -1,0 +1,708 @@
+"""graftlint SPMD tier: compiled-program contracts for sharded solvers.
+
+The IR tier (analysis/ir.py) walks jaxprs — what the PROGRAMMER wrote.
+Sharding contracts live one layer lower: GSPMD inserts collectives at
+COMPILE time (sharding propagation over the lowered module), donation is
+an aliasing annotation on the lowered program, and per-device HBM is an
+XLA buffer-assignment fact. None of them are visible in a jaxpr. This
+tier compiles the REAL solver entry points — `solve_scan` relax on/off,
+`solve_runs`, the sweep/setsweep kernels, and the lane-sharded
+`fleet_solve_scan` placed by `shard_lanes` over an 8-virtual-device mesh
+— and walks the compiled HLO / StableHLO text for four rule families:
+
+- `spmd-collectives`: per-program census of collective primitives
+  (all-gather / all-reduce / collective-permute / …) pinned EXACT in
+  kernel_budgets.json. Every single-device program and the lane-sharded
+  fleet program budget to exact-zero: the fleet axis is independent
+  whole solves, so a collective appearing there means the lane axis
+  leaked into a cross-device reduction (the GSPMD silent-insertion
+  failure mode docs/sharding.md warns about).
+- `spmd-hbm`: per-device argument/output/temp bytes from
+  `compiled.memory_analysis()` pinned as ceilings, plus a predicted-vs-
+  measured cross-check against the `aot_manifest.json` cost-catalog rows
+  (solver/aot.py `_cost_blocks`) so the "predict the largest-solvable-
+  problem curve" claim (ROADMAP item 4) stays mechanically honest.
+- `spmd-donation`: `input_output_aliases`/donation census per program,
+  pinned at today's exact-zero — the carry-donation PR (ROADMAP item 1)
+  must flip the budget intentionally, and the temp-byte delta shows up
+  in the same report.
+- `spmd-launch-lock`: an AST rule — any call dispatching a sharded
+  program (`fleet_dispatch` / `shard_lanes`-derived operands) must sit
+  inside the module launch-lock critical section WITH the result fetch
+  (solver/fleet.py `_MESH_DISPATCH_LOCK`: two sharded programs in
+  flight interleave their collective rendezvous and deadlock — observed
+  live; the fetch rides inside the lock so the program has retired
+  before the next launch).
+
+Budget entries share kernel_budgets.json with the IR tier under the
+`spmd:` name prefix (analysis/budgets.py SPMD_PREFIX); each tier
+compares against its own `scoped()` slice. The baseline is
+graftlint.spmd.baseline.json (engine.SPMD_DEFAULT_BASELINE).
+
+Like ir.py, this module imports JAX lazily inside functions: importing
+`karpenter_tpu.analysis` stays JAX-free, and the CLI loads this module
+only under `--spmd` (after `ensure_host_devices()` has pinned the
+8-virtual-device CPU mesh, which must happen before the first jax
+import).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import sys
+from typing import Any, Callable, Iterable, Optional
+
+from karpenter_tpu.analysis import budgets as budgets_mod
+from karpenter_tpu.analysis import engine
+from karpenter_tpu.analysis.engine import (
+    SPMD_DEFAULT_BASELINE,
+    FileContext,
+    Finding,
+    Rule,
+    iter_functions,
+)
+
+SPMD_RULES: dict[str, str] = {
+    "spmd-collectives": (
+        "collective-primitive census of every compiled solver program "
+        "pinned exact in kernel_budgets.json (fleet/lane programs: zero)"
+    ),
+    "spmd-hbm": (
+        "per-device argument/output/temp HBM bytes pinned as ceilings; "
+        "cross-checked against the aot_manifest.json cost catalog"
+    ),
+    "spmd-donation": (
+        "input/output aliasing (buffer donation) census per program, "
+        "pinned exact (zero until the carry-donation PR flips it)"
+    ),
+    "spmd-launch-lock": (
+        "sharded dispatches must ride inside the fleet launch-lock "
+        "critical section with the result fetch included"
+    ),
+}
+
+# metric -> owning rule (budget comparisons surface under the rule whose
+# contract the metric measures; entry-level issues default to the census)
+_METRIC_RULE = {
+    "collectives_all_gather": "spmd-collectives",
+    "collectives_all_reduce": "spmd-collectives",
+    "collectives_permute": "spmd-collectives",
+    "collectives_other": "spmd-collectives",
+    "donated_args": "spmd-donation",
+    "hbm_argument_bytes": "spmd-hbm",
+    "hbm_output_bytes": "spmd-hbm",
+    "hbm_temp_bytes": "spmd-hbm",
+}
+
+_MESH_DEVICES = 8
+
+
+def ensure_host_devices(n_devices: int = _MESH_DEVICES) -> None:
+    """Pin the virtual CPU mesh BEFORE the first jax import (the env is
+    read once at backend init; tests/conftest.py does the same for
+    pytest). A no-op when jax is already imported — the caller then gets
+    whatever device count exists, and the fleet program errors out with
+    a diagnostic instead of silently measuring an unsharded stand-in."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+
+# ---------------------------------------------------------------------------
+# compiled-module censuses (pure text walking — unit-testable on any
+# HLO/StableHLO string, and shared with __graft_entry__.dryrun_multichip
+# so the dry run and the lint gate cannot drift)
+
+# HLO opcodes of cross-device collectives. `-start`/`-done` are the
+# async-pair forms; a pair is ONE collective (the `-done` is skipped).
+_COLLECTIVE_OPS = (
+    "all-gather",
+    "all-reduce",
+    "all-to-all",
+    "collective-permute",
+    "collective-broadcast",
+    "reduce-scatter",
+)
+_COLLECTIVE_RE = re.compile(
+    r"\b(" + "|".join(_COLLECTIVE_OPS) + r")(-start|-done)?\("
+)
+
+
+def collective_census(hlo_text: str) -> dict[str, int]:
+    """Collective-primitive counts in one compiled (post-GSPMD) HLO
+    module. Must run on `compiled.as_text()`: sharding propagation
+    inserts collectives at compile time, so jaxpr/StableHLO text from
+    before compilation cannot see them."""
+    census = {op: 0 for op in _COLLECTIVE_OPS}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        if m.group(2) == "-done":
+            continue
+        census[m.group(1)] += 1
+    return census
+
+
+def collective_metrics(census: dict[str, int]) -> dict[str, int]:
+    """Fold a census into the budgeted metric names (permute and the
+    rarer ops get their own buckets so a budget diff names the family)."""
+    return {
+        "collectives_all_gather": census.get("all-gather", 0),
+        "collectives_all_reduce": census.get("all-reduce", 0),
+        "collectives_permute": census.get("collective-permute", 0),
+        "collectives_other": (
+            census.get("all-to-all", 0)
+            + census.get("reduce-scatter", 0)
+            + census.get("collective-broadcast", 0)
+        ),
+    }
+
+
+# donation surfaces as `tf.aliasing_output` (jax donate_argnums) or
+# `jax.buffer_donor` attributes in the lowered StableHLO — one
+# occurrence per donated input argument
+_DONATION_RE = re.compile(r"tf\.aliasing_output|jax\.buffer_donor")
+
+
+def donation_census(stablehlo_text: str) -> int:
+    """Donated/aliased input count in one lowered StableHLO module
+    (`lowered.as_text()`)."""
+    return len(_DONATION_RE.findall(stablehlo_text))
+
+
+# memory_analysis attributes backing the budgeted per-device HBM metrics
+_HBM_ATTRS = {
+    "hbm_argument_bytes": "argument_size_in_bytes",
+    "hbm_output_bytes": "output_size_in_bytes",
+    "hbm_temp_bytes": "temp_size_in_bytes",
+}
+
+
+def hbm_metrics(compiled: Any) -> dict[str, int]:
+    """Per-device argument/output/temp bytes from XLA buffer assignment.
+    A backend without memory_analysis raises — a broken gate (exit 2),
+    never a silently un-policed ceiling."""
+    ma = compiled.memory_analysis()
+    out = {}
+    for metric, attr in _HBM_ATTRS.items():
+        v = getattr(ma, attr, None)
+        if not isinstance(v, (int, float)):
+            raise RuntimeError(
+                f"memory_analysis() exposes no {attr} on this backend"
+            )
+        out[metric] = int(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the compiled-program set
+
+_KERNEL_PATH = "karpenter_tpu/solver/tpu_kernel.py"
+_RUNS_PATH = "karpenter_tpu/solver/tpu_runs.py"
+_SWEEP_PATH = "karpenter_tpu/controllers/disruption/sweep.py"
+_SETSWEEP_PATH = "karpenter_tpu/controllers/disruption/setsweep.py"
+_FLEET_PATH = "karpenter_tpu/solver/fleet.py"
+_AOT_PATH = "karpenter_tpu/solver/aot.py"
+
+FLEET_ENTRY = budgets_mod.SPMD_PREFIX + "fleet_solve_scan[B=8,sharded]"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpmdProgram:
+    """One compiled entry. `build` returns (fn, args) — the same builder
+    closures the IR tier traces (analysis/ir.py), so the two tiers can
+    never measure different programs under one name."""
+
+    name: str  # `spmd:`-prefixed kernel_budgets.json entry name
+    path: str
+    kit: str
+    build: Callable[[Any], tuple]
+
+
+def _build_fleet_sharded(kit: Any) -> tuple:
+    """The headline program: fleet_fn's vmapped solve over lane operands
+    PLACED by solver/fleet.py shard_lanes on the 8-device `fleet` mesh.
+    Lanes are independent whole solves — the compiled module must carry
+    ZERO collectives (the batch axis propagates end to end; anything
+    else means GSPMD turned a lane-local op into a cross-device one)."""
+    import jax
+
+    from karpenter_tpu.solver import fleet as fleet_mod
+
+    B = _MESH_DEVICES
+    if len(jax.devices()) < B or not fleet_mod._mesh_active(B):
+        raise RuntimeError(
+            f"lane sharding needs a {B}-device mesh (set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={B} before the "
+            "first jax import; ensure_host_devices() does this for the "
+            "CLI)"
+        )
+    st_b, xs_b = fleet_mod.stack_lanes([kit.st] * B, [kit.xs] * B)
+    st_b, xs_b = fleet_mod.shard_lanes(st_b, xs_b)
+    return fleet_mod.fleet_fn(False, sharded=True), (kit.tb, st_b, xs_b)
+
+
+def _programs() -> tuple[SpmdProgram, ...]:
+    from karpenter_tpu.analysis import ir
+
+    P = budgets_mod.SPMD_PREFIX
+    return (
+        SpmdProgram(
+            P + "solve_scan[relax=False]", _KERNEL_PATH, "generic",
+            ir._ep_solve_scan(False),
+        ),
+        SpmdProgram(
+            P + "solve_scan[relax=True]", _KERNEL_PATH, "mixed",
+            ir._ep_solve_scan(True),
+        ),
+        SpmdProgram(
+            P + "solve_runs[relax=False]", _RUNS_PATH, "generic",
+            ir._ep_solve_runs(False),
+        ),
+        SpmdProgram(
+            P + "solve_runs[relax=True]", _RUNS_PATH, "mixed",
+            ir._ep_solve_runs(True),
+        ),
+        SpmdProgram(
+            P + "_fast_sweep_kernel", _SWEEP_PATH, "generic", ir._ep_sweep
+        ),
+        SpmdProgram(
+            P + "_set_sweep_kernel", _SETSWEEP_PATH, "generic",
+            ir._ep_set_sweep,
+        ),
+        SpmdProgram(FLEET_ENTRY, _FLEET_PATH, "generic", _build_fleet_sharded),
+    )
+
+
+def _lower(fn: Any, args: tuple) -> Any:
+    """jax Lowered for one builder result. Already-jitted entries
+    (fleet_fn) lower directly; partials with keyword-bound flags
+    (sweep's `singleton`) jit with those names static — mirroring the
+    AOT prewarm (solver/aot.py), so the compiled program is the one
+    production dispatches."""
+    import functools
+
+    import jax
+
+    if isinstance(fn, functools.partial) and fn.keywords:
+        jitted = jax.jit(fn.func, static_argnames=tuple(fn.keywords))
+        return jitted.lower(*args, **fn.keywords)
+    if hasattr(fn, "lower"):
+        return fn.lower(*args)
+    return jax.jit(fn).lower(*args)
+
+
+def compile_program(prog: SpmdProgram) -> tuple[Any, Any]:
+    """(lowered, compiled) for one program on its representative kit."""
+    from karpenter_tpu.analysis import ir
+
+    kit = ir.build_kit(prog.kit)
+    fn, args = prog.build(kit)
+    lowered = _lower(fn, args)
+    return lowered, lowered.compile()
+
+
+def _entry_paths() -> dict[str, str]:
+    return {p.name: p.path for p in _programs()}
+
+
+# ---------------------------------------------------------------------------
+# spmd-launch-lock: the one AST rule of the tier (runs through the
+# engine's FileContext so suppressions and the baseline work unchanged)
+
+_LOCK_RE = re.compile(r"DISPATCH_LOCK")
+_FETCH_RE = re.compile(r"\b(device_get|block_until_ready)\b")
+
+# callees that consume sharded operands WITHOUT launching a program:
+# placement/fetch/tree plumbing, and `.lower`/`.compile` (the AOT
+# prewarm compiles sharded fleet combos ahead of time — compilation is
+# not a launch and takes no lock, solver/aot.py)
+_ALLOWED_CALLEES = frozenset(
+    {
+        "lower", "compile", "shard_lanes", "stack_lanes", "device_put",
+        "device_get", "block_until_ready", "tree_map", "tree_leaves",
+        "asarray", "array", "len", "print",
+    }
+)
+
+
+class LaunchLockRule(Rule):
+    id = "spmd-launch-lock"
+    summary = SPMD_RULES["spmd-launch-lock"]
+    targets = ("karpenter_tpu/**/*.py", "__graft_entry__.py")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out = []
+        for scope in self._scopes(ctx.tree):
+            out.extend(self._check_scope(ctx, scope))
+        return out
+
+    @staticmethod
+    def _scopes(tree: ast.Module) -> Iterable[list[ast.AST]]:
+        """Per-function analysis (sharded-name tracking must not leak
+        between functions: fleet.py's dispatch primitive takes sharded
+        PARAMETERS, which its callers — not its body — lock around),
+        plus one pseudo-scope of module-level statements."""
+        for fn in iter_functions(tree):
+            yield [fn]
+        yield [
+            node
+            for node in tree.body
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            )
+        ]
+
+    @staticmethod
+    def _callee(call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        if isinstance(f, ast.Name):
+            return f.id
+        return None
+
+    @classmethod
+    def _is_shard_call(cls, node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and cls._callee(node) == "shard_lanes"
+
+    def _check_scope(
+        self, ctx: FileContext, scope: list[ast.AST]
+    ) -> list[Finding]:
+        sharded: set[str] = set()
+        locked: list[tuple[int, int, bool]] = []  # (lo, hi, has_fetch)
+        calls: list[ast.Call] = []
+        for root in scope:
+            for node in ast.walk(root):
+                if isinstance(node, ast.Assign) and self._is_shard_call(
+                    node.value
+                ):
+                    for t in node.targets:
+                        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                        sharded.update(
+                            e.id for e in elts if isinstance(e, ast.Name)
+                        )
+                elif isinstance(node, ast.With):
+                    # the conditional form `LOCK if sharded else
+                    # nullcontext()` counts: its segment names the lock
+                    if any(
+                        _LOCK_RE.search(ctx.segment(item.context_expr))
+                        for item in node.items
+                    ):
+                        locked.append(
+                            (
+                                node.lineno,
+                                node.end_lineno or node.lineno,
+                                bool(_FETCH_RE.search(ctx.segment(node))),
+                            )
+                        )
+                elif isinstance(node, ast.Call):
+                    calls.append(node)
+        out = []
+        for call in calls:
+            callee = self._callee(call)
+            if callee in _ALLOWED_CALLEES:
+                continue
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            dispatches = (callee == "fleet_dispatch" and sharded) or any(
+                isinstance(a, ast.Name) and a.id in sharded for a in args
+            ) or any(self._is_shard_call(a) for a in args)
+            if not dispatches:
+                continue
+            enclosing = [w for w in locked if w[0] <= call.lineno <= w[1]]
+            if not enclosing:
+                out.append(
+                    ctx.finding(
+                        self.id,
+                        call,
+                        f"`{callee}(...)` dispatches a sharded program "
+                        "outside the `_MESH_DISPATCH_LOCK` critical "
+                        "section — concurrent sharded launches interleave "
+                        "their collective rendezvous and deadlock "
+                        "(solver/fleet.py launch-order contract)",
+                    )
+                )
+            elif not any(has_fetch for _, _, has_fetch in enclosing):
+                out.append(
+                    ctx.finding(
+                        self.id,
+                        call,
+                        f"`{callee}(...)` holds the launch lock but the "
+                        "critical section fetches no result (device_get/"
+                        "block_until_ready) — the program must RETIRE "
+                        "before the lock releases, or the next sharded "
+                        "launch can still interleave its rendezvous",
+                    )
+                )
+        return out
+
+
+def launch_lock_findings(
+    repo_root: str, rule_ids: Optional[set] = None
+) -> tuple[list[Finding], list[str]]:
+    """Run the launch-lock rule over the package plus the driver entry
+    (`__graft_entry__.py` dispatches the fleet program too — the dry run
+    must obey the same contract it validates)."""
+    if "spmd-launch-lock" not in _active(rule_ids):
+        return [], []
+    config = engine.Config.for_repo(repo_root)
+    files = engine.discover_files(repo_root)
+    entry = os.path.join(repo_root, "__graft_entry__.py")
+    if os.path.exists(entry):
+        files = sorted(set(files) | {entry})
+    return engine.analyze_files(
+        files, config, rules=[LaunchLockRule()],
+        rule_ids={"spmd-launch-lock"},
+    )
+
+
+# ---------------------------------------------------------------------------
+# the runner
+
+
+def _active(rule_ids: Optional[set]) -> set:
+    return (
+        set(SPMD_RULES)
+        if rule_ids is None
+        else set(rule_ids) & set(SPMD_RULES)
+    )
+
+
+def _hbm_cross_checks(
+    measured: dict[str, dict[str, int]],
+    compiled_by_name: dict[str, Any],
+    errors: list[str],
+    errored: set[str],
+) -> list[Finding]:
+    """The predicted-vs-measured half of spmd-hbm:
+
+    1. `aot._cost_blocks` (the SHARED helper that fills the
+       aot_manifest.json cost catalog) must report the same byte totals
+       as the direct memory_analysis() read for every program this tier
+       compiled — if the catalog's extraction path rots, /debug/programs
+       would mispredict per-device HBM while this tier still passed.
+    2. Every live manifest row recorded by the same jax/backend must
+       carry well-formed memory data (a pre-catalog or rotted row means
+       the capacity curve is built on holes — re-run the prewarm).
+    3. The lane-sharded fleet program must pin STRICTLY fewer argument
+       bytes per device than its unsharded twin — the capacity claim
+       sharding exists for (docs/sharding.md)."""
+    findings: list[Finding] = []
+    if not compiled_by_name:
+        return findings
+    import jax
+
+    from karpenter_tpu.solver import aot
+
+    for name in sorted(compiled_by_name):
+        _, mem = aot._cost_blocks(compiled_by_name[name])
+        for metric, attr in _HBM_ATTRS.items():
+            if mem.get(attr) != measured[name][metric]:
+                findings.append(
+                    Finding(
+                        rule="spmd-hbm",
+                        path=_AOT_PATH,
+                        line=1,
+                        message=(
+                            f"{name}: aot._cost_blocks reports "
+                            f"{attr}={mem.get(attr)} but memory_analysis() "
+                            f"measures {measured[name][metric]} — the "
+                            "/debug/programs cost catalog would mispredict "
+                            "per-device HBM (ROADMAP item 4 input)"
+                        ),
+                        text=name,
+                    )
+                )
+    try:
+        from karpenter_tpu.jaxsetup import ensure_compilation_cache
+
+        cache_dir = ensure_compilation_cache()
+        manifest = aot.load_manifest(cache_dir)
+    except Exception as e:
+        errors.append(f"aot_manifest: {type(e).__name__}: {e}")
+        errored.add("aot_manifest")
+        manifest = {}
+    if (
+        manifest.get("jax") == jax.__version__
+        and manifest.get("backend") == jax.default_backend()
+    ):
+        for combo in sorted(manifest.get("combos", {})):
+            mem = manifest["combos"][combo].get("memory") or {}
+            missing = [
+                attr
+                for attr in _HBM_ATTRS.values()
+                if not isinstance(mem.get(attr), int)
+            ]
+            if missing:
+                findings.append(
+                    Finding(
+                        rule="spmd-hbm",
+                        path=_AOT_PATH,
+                        line=1,
+                        message=(
+                            f"aot_manifest.json combo `{combo}` lacks "
+                            f"memory data ({', '.join(missing)}) although "
+                            "this backend supports memory_analysis() — "
+                            "re-run the prewarm so the capacity catalog "
+                            "stays predictive"
+                        ),
+                        text=combo,
+                    )
+                )
+    if FLEET_ENTRY in measured:
+        try:
+            from karpenter_tpu.analysis import ir
+
+            kit = ir.build_kit("generic")
+            fn, args = ir._ep_fleet(kit)
+            unsharded = _lower(fn, args).compile()
+            un_arg = hbm_metrics(unsharded)["hbm_argument_bytes"]
+            sh_arg = measured[FLEET_ENTRY]["hbm_argument_bytes"]
+            if not sh_arg < un_arg:
+                findings.append(
+                    Finding(
+                        rule="spmd-hbm",
+                        path=_FLEET_PATH,
+                        line=1,
+                        message=(
+                            f"lane-sharded fleet program pins {sh_arg} "
+                            "argument bytes per device, not fewer than the "
+                            f"unsharded program's {un_arg} — lane sharding "
+                            "stopped dividing per-device HBM (the capacity "
+                            "axis docs/sharding.md claims)"
+                        ),
+                        text=FLEET_ENTRY,
+                    )
+                )
+        except Exception as e:
+            errors.append(
+                f"{FLEET_ENTRY} (unsharded twin): {type(e).__name__}: {e}"
+            )
+            errored.add(FLEET_ENTRY)
+    return findings
+
+
+def measure(
+    rule_ids: Optional[set] = None,
+) -> tuple[dict[str, dict[str, int]], list[Finding], list[str], set[str]]:
+    """Compile every program and take its censuses. Returns (measured
+    metrics by entry, direct findings, errors, errored entry names) — a
+    program that no longer compiles is a broken gate (exit 2), and its
+    budget entry must not read as orphaned."""
+    active = _active(rule_ids)
+    measured: dict[str, dict[str, int]] = {}
+    findings: list[Finding] = []
+    errors: list[str] = []
+    errored: set[str] = set()
+    if not active & {"spmd-collectives", "spmd-hbm", "spmd-donation"}:
+        return measured, findings, errors, errored
+    compiled_by_name: dict[str, Any] = {}
+    for prog in _programs():
+        try:
+            lowered, compiled = compile_program(prog)
+            metrics = collective_metrics(
+                collective_census(compiled.as_text())
+            )
+            metrics["donated_args"] = donation_census(lowered.as_text())
+            metrics.update(hbm_metrics(compiled))
+        except Exception as e:
+            errors.append(f"{prog.name}: {type(e).__name__}: {e}")
+            errored.add(prog.name)
+            continue
+        measured[prog.name] = metrics
+        compiled_by_name[prog.name] = compiled
+    if "spmd-hbm" in active:
+        findings.extend(
+            _hbm_cross_checks(measured, compiled_by_name, errors, errored)
+        )
+    return measured, findings, errors, errored
+
+
+def budget_findings(
+    measured: dict[str, dict[str, int]],
+    manifest: budgets_mod.BudgetManifest,
+    rule_ids: Optional[set] = None,
+    errored: Optional[set] = None,
+) -> tuple[list[Finding], list[str]]:
+    """Compare measurements against the tier's manifest slice (the
+    caller passes `manifest.scoped(spmd=True)`); same orphan suppression
+    as the IR tier: partial runs and errored entries never read as rot."""
+    active = _active(rule_ids)
+    cmp = manifest.compare(measured)
+    paths = _entry_paths()
+    findings = []
+    for issue in cmp.issues:
+        if issue.kind == "orphaned-entry" and (
+            rule_ids is not None or issue.entry in (errored or ())
+        ):
+            continue
+        rule = _METRIC_RULE.get(issue.metric or "", "spmd-collectives")
+        if rule not in active:
+            continue
+        findings.append(
+            Finding(
+                rule=rule,
+                path=paths.get(issue.entry, _FLEET_PATH),
+                line=1,
+                message=issue.render(),
+                text=issue.entry,
+            )
+        )
+    return findings, [i.render() for i in cmp.improvements]
+
+
+def run_spmd_analysis(
+    repo_root: str,
+    budgets_path: Optional[str] = None,
+    baseline_path: Optional[str] = None,
+    rule_ids: Optional[set] = None,
+) -> dict:
+    """The SPMD pipeline: compile, census, compare against the `spmd:`
+    slice of kernel_budgets.json, run the launch-lock AST rule, apply
+    graftlint.spmd.baseline.json. Mirrors ir.run_ir_analysis's report
+    shape exactly."""
+    from karpenter_tpu.analysis.engine import Baseline
+
+    budgets_path = budgets_path or os.path.join(
+        repo_root, budgets_mod.DEFAULT_MANIFEST
+    )
+    baseline_path = (
+        baseline_path
+        if baseline_path is not None
+        else os.path.join(repo_root, SPMD_DEFAULT_BASELINE)
+    )
+    manifest = budgets_mod.BudgetManifest.load(budgets_path).scoped(spmd=True)
+    measured, findings, errors, errored = measure(rule_ids)
+    bfindings, improvements = budget_findings(
+        measured, manifest, rule_ids, errored=errored
+    )
+    ll_findings, ll_errors = launch_lock_findings(repo_root, rule_ids)
+    findings = sorted(
+        findings + bfindings + ll_findings,
+        key=lambda f: (f.path, f.rule, f.text),
+    )
+    baseline = Baseline.load(baseline_path)
+    fresh, stale = baseline.apply(findings)
+    budget_unjustified = (
+        manifest.unjustified()
+        if _active(rule_ids)
+        >= {"spmd-collectives", "spmd-hbm", "spmd-donation"}
+        else []
+    )
+    return {
+        "findings": fresh,
+        "all_findings": findings,
+        "stale": stale,
+        "unjustified": baseline.unjustified(),
+        "budget_unjustified": budget_unjustified,
+        "improvements": improvements,
+        "errors": errors + ll_errors,
+        "measured": measured,
+        "manifest": manifest,
+    }

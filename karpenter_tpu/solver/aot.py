@@ -390,8 +390,10 @@ def prewarm(
                         compile_combo(
                             name,
                             sig,
-                            lambda st_b=st_b, xs_b=xs_b, relax=relax: (
-                                fleet_mod.fleet_fn(relax)
+                            lambda st_b=st_b, xs_b=xs_b, relax=relax, B=B: (
+                                fleet_mod.fleet_fn(
+                                    relax, sharded=fleet_mod._mesh_active(B)
+                                )
                                 .lower(tb, st_b, xs_b)
                                 .compile()
                             ),

@@ -158,16 +158,29 @@ def shard_lanes(st_b, xs_b):
     return jax.device_put(st_b, lane_sh), jax.device_put(xs_b, lane_sh)
 
 
-_fleet_fn_cache: dict[bool, object] = {}
+_fleet_fn_cache: dict[tuple, object] = {}
 
 
-def fleet_fn(relax: bool):
+def fleet_fn(relax: bool, sharded: bool = False):
     """The jitted vmapped solve entry: `vmap(solve_scan, in_axes=(None,
     0, 0))` — tables shared, State/PodX per lane. Module-level cache per
-    relax flag (a per-call closure would recompile every window); the
-    jit cache then keys on the (B, P, N) bucketed shapes, which the AOT
-    prewarm ladder covers (solver/aot.py fleet combos)."""
-    fn = _fleet_fn_cache.get(relax)
+    (relax, sharded) pair (a per-call closure would recompile every
+    window); the jit cache then keys on the (B, P, N) bucketed shapes,
+    which the AOT prewarm ladder covers (solver/aot.py fleet combos).
+
+    `sharded` wraps the vmapped solve in a `shard_map` over the `fleet`
+    mesh axis so each device runs its own lane block INDEPENDENTLY.
+    Under plain vmap, GSPMD lifts every data-dependent `while_loop`
+    predicate inside solve_scan to a cross-LANE reduce_or ("run until
+    all lanes are done"), and on a sharded lane axis that consensus
+    compiles to a per-iteration all-reduce over the whole mesh — a real
+    cross-device collective on the fleet axis, caught by `graftlint
+    --spmd`'s collective census (the lane-sharded budget pins zero).
+    shard_map keeps the loop predicates device-local: lanes are
+    independent whole solves, so no consensus is needed, the compiled
+    program carries ZERO collectives, and per-lane results stay
+    bit-identical (each lane runs the same solo program either way)."""
+    fn = _fleet_fn_cache.get((relax, sharded))
     if fn is None:
         import functools
 
@@ -175,23 +188,44 @@ def fleet_fn(relax: bool):
 
         from karpenter_tpu.solver import tpu_kernel as K
 
-        fn = jax.jit(
-            jax.vmap(
-                functools.partial(K.solve_scan, relax=relax),
-                in_axes=(None, 0, 0),
-            )
+        vmapped = jax.vmap(
+            functools.partial(K.solve_scan, relax=relax),
+            in_axes=(None, 0, 0),
         )
-        _fleet_fn_cache[relax] = fn
+        if sharded:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            mesh = Mesh(np.array(jax.devices()), ("fleet",))
+            # check_rep=False: the replication checker rejects the
+            # solver's while loops; correctness rides on lanes being
+            # independent (the bit-parity the fleet tests pin)
+            vmapped = shard_map(
+                vmapped,
+                mesh=mesh,
+                in_specs=(P(), P("fleet"), P("fleet")),
+                out_specs=P("fleet"),
+                check_rep=False,
+            )
+        fn = jax.jit(vmapped)
+        _fleet_fn_cache[(relax, sharded)] = fn
     return fn
 
 
-def fleet_dispatch(tb, st_b, xs_b, relax: bool = True):
+def fleet_dispatch(tb, st_b, xs_b, relax: bool = True, sharded=None):
     """ONE device dispatch running every stacked lane's solve step
     batch; returns (st_b, kinds_b, slots_b, over_b, odo_b) with a
     leading lane axis (over_b is per lane — solve_scan's any-overflow
     scalar, mapped; odo_b the per-lane kernel odometer block).
-    Counted under the existing per-dispatch accounting as path=fleet."""
-    out = fleet_fn(relax)(tb, st_b, xs_b)
+    Counted under the existing per-dispatch accounting as path=fleet.
+
+    `sharded` selects the shard_map program variant (see fleet_fn);
+    None means auto — the same `_mesh_active` condition `shard_lanes`
+    places under. Callers that deliberately keep a divisible batch off
+    the mesh (`SolverServer(use_mesh=False)`) pass sharded=False."""
+    if sharded is None:
+        sharded = _mesh_active(int(xs_b.valid.shape[0]))
+    out = fleet_fn(relax, sharded=sharded)(tb, st_b, xs_b)
     tracing.SOLVE_DISPATCHES.inc({"path": "fleet"})
     return out
 
@@ -525,7 +559,7 @@ class FleetCoalescer:
             # on backends that overlap execution
             with _MESH_DISPATCH_LOCK if sharded else contextlib.nullcontext():
                 st_b, kinds_b, slots_b, over_b, odo_b = fleet_dispatch(
-                    tb, st_b, xs_b, relax=relax
+                    tb, st_b, xs_b, relax=relax, sharded=sharded
                 )
                 kinds_b, slots_b, over_b, odo_b = jax.device_get(
                     (kinds_b, slots_b, over_b, odo_b)

@@ -1310,18 +1310,16 @@ def test_cli_all_forwards_reference_root(tmp_path, monkeypatch, capsys):
             "total": 0, "all_findings": [],
         }
 
-    from karpenter_tpu.analysis import ir, locks
+    from karpenter_tpu.analysis import ir, locks, spmd
 
     monkeypatch.setattr(cli, "run_analysis", fake_run_analysis)
     monkeypatch.setattr(locks, "run_race_analysis", fake_race)
-    monkeypatch.setattr(
-        ir,
-        "run_ir_analysis",
-        lambda *a, **kw: {
-            "findings": [], "all_findings": [], "stale": [], "unjustified": [],
-            "budget_unjustified": [], "improvements": [], "errors": [], "measured": {},
-        },
-    )
+    traced_tier_stub = lambda *a, **kw: {
+        "findings": [], "all_findings": [], "stale": [], "unjustified": [],
+        "budget_unjustified": [], "improvements": [], "errors": [], "measured": {},
+    }
+    monkeypatch.setattr(ir, "run_ir_analysis", traced_tier_stub)
+    monkeypatch.setattr(spmd, "run_spmd_analysis", traced_tier_stub)
     (tmp_path / "karpenter_tpu").mkdir()
     rc = graftlint_main(
         ["--root", str(tmp_path), "--all", "--reference-root", "/elsewhere/ref"]
@@ -1347,7 +1345,7 @@ def test_cli_all_text_mode_itemizes_baseline_problems(tmp_path, capsys, monkeypa
     """An exit-1 --all run must name each stale/unjustified entry (with
     its tier prefix) exactly as the single-tier modes do — an aggregate
     count alone is not actionable in a CI log."""
-    from karpenter_tpu.analysis import ir
+    from karpenter_tpu.analysis import ir, spmd
 
     def fake_ir(repo_root, budgets_path=None, baseline_path=None, rule_ids=None):
         return {
@@ -1361,7 +1359,13 @@ def test_cli_all_text_mode_itemizes_baseline_problems(tmp_path, capsys, monkeypa
             "measured": {},
         }
 
+    def fake_spmd(repo_root, budgets_path=None, baseline_path=None, rule_ids=None):
+        out = fake_ir(repo_root)
+        out["budget_unjustified"] = []
+        return out
+
     monkeypatch.setattr(ir, "run_ir_analysis", fake_ir)
+    monkeypatch.setattr(spmd, "run_spmd_analysis", fake_spmd)
     (tmp_path / "karpenter_tpu").mkdir()
     (tmp_path / "karpenter_tpu" / "x.py").write_text("x = 1\n", encoding="utf-8")
     (tmp_path / "graftlint.race.baseline.json").write_text(
@@ -1387,11 +1391,12 @@ def test_cli_all_text_mode_itemizes_baseline_problems(tmp_path, capsys, monkeypa
 
 
 def test_cli_all_merges_tiers_with_worst_exit_code(capsys, monkeypatch):
-    """--all = AST + race + IR with one worst-case exit code. The IR tier
-    is stubbed here (the real trace run has its own tier-1 gate in
-    test_ir_analysis.py; tracing kernels twice per suite would double
-    that cost for no new coverage)."""
-    from karpenter_tpu.analysis import ir
+    """--all = AST + race + IR + SPMD with one worst-case exit code. The
+    traced tiers are stubbed here (the real trace/compile runs have their
+    own tier-1 gates in test_ir_analysis.py / test_spmd_analysis.py;
+    running them twice per suite would double that cost for no new
+    coverage)."""
+    from karpenter_tpu.analysis import ir, spmd
 
     def fake_ir(repo_root, budgets_path=None, baseline_path=None, rule_ids=None):
         return {
@@ -1406,10 +1411,24 @@ def test_cli_all_merges_tiers_with_worst_exit_code(capsys, monkeypatch):
         }
 
     monkeypatch.setattr(ir, "run_ir_analysis", fake_ir)
+    monkeypatch.setattr(
+        spmd,
+        "run_spmd_analysis",
+        lambda repo_root, budgets_path=None, baseline_path=None, rule_ids=None: {
+            "findings": [],
+            "all_findings": [],
+            "stale": [],
+            "unjustified": [],
+            "budget_unjustified": [],
+            "improvements": [],
+            "errors": [],
+            "measured": {"spmd:solve_scan[relax=False]": {}},
+        },
+    )
     rc = graftlint_main(["--root", REPO_ROOT, "--all", "--json"])
     data = json.loads(capsys.readouterr().out)
     assert rc == 0
-    assert set(data) == {"ast", "race", "ir", "exit_code"}
+    assert set(data) == {"ast", "race", "ir", "spmd", "exit_code"}
     assert data["exit_code"] == 0
     assert data["ast"]["findings"] == [] and data["race"]["findings"] == []
     assert data["ir"]["exit_code"] == 0

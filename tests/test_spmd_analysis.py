@@ -1,0 +1,715 @@
+"""graftlint SPMD tier gate (analysis/spmd.py): per-rule positive and
+negative fixtures, the compiled-program censuses against doctored jits,
+the budget comparison against doctored manifests, the launch-lock AST
+rule on synthetic dispatch sites, the `--all` merge, and the full-tree
+run — every solver program compiles collective-free/donation-free and
+matches the `spmd:` half of kernel_budgets.json.
+
+The module-scoped `report` fixture does the expensive work once:
+compiles the seven programs (including the lane-sharded fleet entry over
+the conftest-pinned 8-virtual-device mesh). Everything else is
+doctored-input unit tests on the censuses, the comparison, and the CLI.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from karpenter_tpu.analysis import budgets as budgets_mod
+from karpenter_tpu.analysis import engine
+from karpenter_tpu.analysis import spmd
+from karpenter_tpu.analysis.__main__ import main as graftlint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def report():
+    return spmd.run_spmd_analysis(REPO_ROOT)
+
+
+@pytest.fixture(scope="module")
+def manifest_entries(report):
+    """Deep-copyable real `spmd:` manifest entries for doctoring."""
+    return {
+        name: copy.deepcopy(e)
+        for name, e in report["manifest"].entries.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# full-tree cleanliness (the gate)
+
+
+def test_full_tree_clean(report):
+    assert report["errors"] == []
+    assert [f.render() for f in report["findings"]] == []
+    assert report["stale"] == []
+    assert report["unjustified"] == []
+    assert report["budget_unjustified"] == []
+
+
+def test_manifest_covers_every_program(report):
+    names = set(spmd._entry_paths())
+    assert all(n.startswith(budgets_mod.SPMD_PREFIX) for n in names)
+    assert spmd.FLEET_ENTRY in names
+    assert set(report["measured"]) == names
+    assert set(report["manifest"].entries) == names
+
+
+def test_collective_and_donation_contracts_hold(report):
+    """The absolute contracts, independent of what the manifest says:
+    every program — the lane-SHARDED fleet entry included — compiles
+    with zero collectives and zero donated inputs today."""
+    for name, metrics in report["measured"].items():
+        for m in (
+            "collectives_all_gather",
+            "collectives_all_reduce",
+            "collectives_permute",
+            "collectives_other",
+            "donated_args",
+        ):
+            assert metrics[m] == 0, (name, m, metrics[m])
+
+
+def test_sharded_fleet_hbm_is_one_lane(report):
+    """Per-device argument bytes of the 8-lane sharded fleet program
+    must match the SOLO program's (each device holds one lane) — the
+    capacity axis docs/sharding.md claims, measured."""
+    sh = report["measured"][spmd.FLEET_ENTRY]["hbm_argument_bytes"]
+    solo = report["measured"]["spmd:solve_scan[relax=False]"][
+        "hbm_argument_bytes"
+    ]
+    assert sh == solo
+
+
+# ---------------------------------------------------------------------------
+# spmd-collectives: census on doctored compiled programs
+
+
+def _lane_sharding():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("fleet",))
+    return NamedSharding(mesh, P("fleet"))
+
+
+def test_census_counts_sharded_reduction():
+    """A sharded-input program whose output is a full reduction forces
+    GSPMD to insert a cross-device all-reduce — the census must see it
+    in the COMPILED module."""
+    x = jax.device_put(jnp.arange(64, dtype=jnp.float32), _lane_sharding())
+    compiled = jax.jit(lambda a: a.sum()).lower(x).compile()
+    census = spmd.collective_census(compiled.as_text())
+    assert census["all-reduce"] + census["all-gather"] >= 1
+    metrics = spmd.collective_metrics(census)
+    assert sum(metrics.values()) >= 1
+
+
+def test_census_zero_on_unsharded_program():
+    compiled = (
+        jax.jit(lambda a: a.sum()).lower(jnp.arange(64.0)).compile()
+    )
+    assert not any(spmd.collective_census(compiled.as_text()).values())
+
+
+def test_census_text_counts_start_not_done():
+    """Async collective pairs are ONE collective: count the `-start`,
+    skip the `-done`; variable REFERENCES like `%all-reduce.5` never
+    count (the opcode is only an op when directly followed by `(`)."""
+    hlo = textwrap.dedent(
+        """\
+        %ar-s = (f32[4], f32[4]) all-reduce-start(f32[4] %p0), to_apply=%add
+        %ar-d = f32[4] all-reduce-done((f32[4], f32[4]) %ar-s)
+        %g = f32[8] all-gather(f32[4] %ar-d), dimensions={0}
+        %use = f32[8] add(f32[8] %g, f32[8] %all-reduce.5)
+        """
+    )
+    census = spmd.collective_census(hlo)
+    assert census["all-reduce"] == 1
+    assert census["all-gather"] == 1
+    assert sum(census.values()) == 2
+
+
+def test_collectives_budget_mismatch_is_exact(report, manifest_entries):
+    """A collective appearing where the budget pins zero is a finding
+    even when it is 'only one' — and a budget expecting one that
+    disappears is ALSO a finding (exact, both directions)."""
+    measured = copy.deepcopy(report["measured"])
+    measured[spmd.FLEET_ENTRY]["collectives_all_reduce"] = 1
+    findings, _ = spmd.budget_findings(
+        measured, budgets_mod.BudgetManifest(copy.deepcopy(manifest_entries))
+    )
+    assert any(
+        f.rule == "spmd-collectives" and f.text == spmd.FLEET_ENTRY
+        for f in findings
+    )
+    entries = copy.deepcopy(manifest_entries)
+    entries[spmd.FLEET_ENTRY]["metrics"]["collectives_all_gather"] = 2
+    findings, _ = spmd.budget_findings(
+        report["measured"], budgets_mod.BudgetManifest(entries)
+    )
+    assert any(f.rule == "spmd-collectives" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# spmd-donation: census on doctored lowered programs
+
+
+def test_donation_census_counts_donated_argument():
+    lowered = jax.jit(lambda a: a + 1, donate_argnums=0).lower(
+        jnp.arange(8.0)
+    )
+    assert spmd.donation_census(lowered.as_text()) == 1
+
+
+def test_donation_census_zero_without_donation():
+    lowered = jax.jit(lambda a: a + 1).lower(jnp.arange(8.0))
+    assert spmd.donation_census(lowered.as_text()) == 0
+
+
+def test_donation_budget_flip_needs_rebaseline(report, manifest_entries):
+    """The carry-donation PR (ROADMAP item 1) flipping donated_args must
+    trip the exact budget until the manifest is intentionally updated."""
+    measured = copy.deepcopy(report["measured"])
+    measured["spmd:solve_scan[relax=True]"]["donated_args"] = 3
+    findings, _ = spmd.budget_findings(
+        measured, budgets_mod.BudgetManifest(copy.deepcopy(manifest_entries))
+    )
+    assert any(
+        f.rule == "spmd-donation" and "donated_args" in f.message
+        for f in findings
+    )
+
+
+# ---------------------------------------------------------------------------
+# spmd-hbm: ceilings and the predicted-vs-measured cross-check
+
+
+def test_hbm_ceiling_breach_detected(report, manifest_entries):
+    entries = copy.deepcopy(manifest_entries)
+    got = report["measured"][spmd.FLEET_ENTRY]["hbm_temp_bytes"]
+    entries[spmd.FLEET_ENTRY]["metrics"]["hbm_temp_bytes"] = got - 1
+    findings, _ = spmd.budget_findings(
+        report["measured"], budgets_mod.BudgetManifest(entries)
+    )
+    assert any(
+        f.rule == "spmd-hbm" and "regressed" in f.message for f in findings
+    )
+
+
+def test_hbm_ceiling_slack_is_not_a_finding(report, manifest_entries):
+    entries = copy.deepcopy(manifest_entries)
+    entries[spmd.FLEET_ENTRY]["metrics"]["hbm_temp_bytes"] += 1 << 20
+    findings, notes = spmd.budget_findings(
+        report["measured"], budgets_mod.BudgetManifest(entries)
+    )
+    assert not any(f.rule == "spmd-hbm" for f in findings)
+    assert any("hbm_temp_bytes" in n for n in notes)
+
+
+def test_hbm_agrees_with_cost_catalog_helper(report):
+    """The shared aot._cost_blocks helper (which fills aot_manifest.json)
+    must extract the same bytes the tier measures — the cross-check
+    measure() runs; here pinned directly for one program."""
+    from karpenter_tpu.solver import aot
+
+    prog = next(
+        p for p in spmd._programs()
+        if p.name == "spmd:solve_scan[relax=False]"
+    )
+    _, compiled = spmd.compile_program(prog)
+    _, mem = aot._cost_blocks(compiled)
+    assert mem["argument_size_in_bytes"] == report["measured"][prog.name][
+        "hbm_argument_bytes"
+    ]
+
+
+def test_hbm_manifest_row_without_memory_is_flagged(monkeypatch, report):
+    """A live aot_manifest.json row recorded by THIS jax/backend but
+    lacking memory data means the capacity catalog has holes — flagged.
+    An absent or other-backend manifest passes vacuously."""
+    from karpenter_tpu.solver import aot
+
+    rows = {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "combos": {"solve@P=64": {"signature": "x", "seconds": 1.0}},
+    }
+    monkeypatch.setattr(aot, "load_manifest", lambda cache_dir: rows)
+    measured = {
+        k: copy.deepcopy(v) for k, v in report["measured"].items()
+    }
+    prog = next(
+        p for p in spmd._programs()
+        if p.name == "spmd:solve_scan[relax=False]"
+    )
+    _, compiled = spmd.compile_program(prog)
+    findings = spmd._hbm_cross_checks(
+        {prog.name: measured[prog.name]}, {prog.name: compiled}, [], set()
+    )
+    assert any("lacks memory data" in f.message for f in findings)
+    # other-backend rows are not this backend's contract
+    rows["backend"] = "not-this-backend"
+    findings = spmd._hbm_cross_checks(
+        {prog.name: measured[prog.name]}, {prog.name: compiled}, [], set()
+    )
+    assert not any("lacks memory data" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# budget mechanics shared with the IR tier (scoped manifest)
+
+
+def test_scoped_manifest_splits_tiers():
+    m = budgets_mod.BudgetManifest(
+        {
+            "solve_scan[relax=False]": {"justification": "ir", "metrics": {}},
+            "spmd:solve_scan[relax=False]": {
+                "justification": "spmd", "metrics": {},
+            },
+        }
+    )
+    assert set(m.scoped(spmd=True).entries) == {"spmd:solve_scan[relax=False]"}
+    assert set(m.scoped(spmd=False).entries) == {"solve_scan[relax=False]"}
+
+
+def test_render_carries_sibling_tier_entries():
+    """--write-budgets under either tier must not truncate the other
+    tier's half of the shared manifest."""
+    existing = budgets_mod.BudgetManifest(
+        {
+            "ir_entry": {"justification": "keep me", "metrics": {"scans": 1}},
+            "spmd:old": {"justification": "stale spmd", "metrics": {}},
+        }
+    )
+    data = budgets_mod.BudgetManifest.render(
+        {"spmd:new": {"donated_args": 0}}, existing, spmd_scope=True
+    )
+    assert set(data["entries"]) == {"ir_entry", "spmd:new"}
+    assert data["entries"]["ir_entry"]["justification"] == "keep me"
+    data = budgets_mod.BudgetManifest.render(
+        {"ir_entry": {"scans": 2}}, existing, spmd_scope=False
+    )
+    assert set(data["entries"]) == {"ir_entry", "spmd:old"}
+
+
+def test_partial_run_does_not_police_orphans(report, manifest_entries):
+    measured = {
+        k: copy.deepcopy(v)
+        for k, v in report["measured"].items()
+        if k != spmd.FLEET_ENTRY
+    }
+    findings, _ = spmd.budget_findings(
+        measured,
+        budgets_mod.BudgetManifest(copy.deepcopy(manifest_entries)),
+        rule_ids={"spmd-hbm"},
+    )
+    assert not any("matches no traced entry point" in f.message for f in findings)
+    findings_full, _ = spmd.budget_findings(
+        measured, budgets_mod.BudgetManifest(copy.deepcopy(manifest_entries))
+    )
+    assert any(
+        "matches no traced entry point" in f.message for f in findings_full
+    )
+
+
+def test_compile_failure_is_not_reported_as_orphan(report, manifest_entries):
+    measured = {
+        k: copy.deepcopy(v)
+        for k, v in report["measured"].items()
+        if k != spmd.FLEET_ENTRY
+    }
+    findings, _ = spmd.budget_findings(
+        measured,
+        budgets_mod.BudgetManifest(copy.deepcopy(manifest_entries)),
+        errored={spmd.FLEET_ENTRY},
+    )
+    assert not any(spmd.FLEET_ENTRY in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# spmd-launch-lock: synthetic dispatch sites
+
+
+def _lock_findings(tmp_path, source: str):
+    root = tmp_path / "repo"
+    path = root / "karpenter_tpu" / "solver" / "snippet.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(textwrap.dedent(source), encoding="utf-8")
+    config = engine.Config.for_repo(str(root))
+    findings, errors = engine.analyze_files(
+        [str(path)], config, rules=[spmd.LaunchLockRule()]
+    )
+    assert errors == []
+    return findings
+
+
+def test_launch_lock_flags_unlocked_dispatch(tmp_path):
+    findings = _lock_findings(
+        tmp_path,
+        """\
+        def go(tb, st_b, xs_b):
+            st_b, xs_b = shard_lanes(st_b, xs_b)
+            out = fleet_dispatch(tb, st_b, xs_b)
+            return out
+        """,
+    )
+    assert len(findings) == 1
+    assert findings[0].rule == "spmd-launch-lock"
+    assert "outside" in findings[0].message
+
+
+def test_launch_lock_flags_missing_fetch(tmp_path):
+    findings = _lock_findings(
+        tmp_path,
+        """\
+        def go(tb, st_b, xs_b):
+            st_b, xs_b = shard_lanes(st_b, xs_b)
+            with _MESH_DISPATCH_LOCK:
+                out = fleet_dispatch(tb, st_b, xs_b)
+            return out
+        """,
+    )
+    assert len(findings) == 1
+    assert "fetches no result" in findings[0].message
+
+
+def test_launch_lock_negative_locked_with_fetch(tmp_path):
+    findings = _lock_findings(
+        tmp_path,
+        """\
+        def go(tb, st_b, xs_b, sharded):
+            st_b, xs_b = shard_lanes(st_b, xs_b)
+            with _MESH_DISPATCH_LOCK if sharded else contextlib.nullcontext():
+                out = fleet_dispatch(tb, st_b, xs_b)
+                out = jax.device_get(out)
+            return out
+        """,
+    )
+    assert findings == []
+
+
+def test_launch_lock_negative_unsharded_scope(tmp_path):
+    """fleet_dispatch over operands never derived from shard_lanes in
+    this scope is a single-device dispatch — no lock required (the
+    fleet.py contract is about SHARDED launches)."""
+    findings = _lock_findings(
+        tmp_path,
+        """\
+        def go(tb, st_b, xs_b):
+            out = fleet_dispatch(tb, st_b, xs_b)
+            return out
+
+        def other(st_b, xs_b):
+            st_b, xs_b = shard_lanes(st_b, xs_b)
+            return jax.device_put(st_b, None)
+        """,
+    )
+    assert findings == []
+
+
+def test_launch_lock_module_level_scope(tmp_path):
+    """Module-level (script-style) dispatches are checked too — the
+    __graft_entry__.py dry run was exactly this shape."""
+    findings = _lock_findings(
+        tmp_path,
+        """\
+        st_b, xs_b = shard_lanes(st_b, xs_b)
+        out = fleet_dispatch(tb, st_b, xs_b)
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_launch_lock_suppression_comment(tmp_path):
+    findings = _lock_findings(
+        tmp_path,
+        """\
+        def go(tb, st_b, xs_b):
+            st_b, xs_b = shard_lanes(st_b, xs_b)
+            out = fleet_dispatch(tb, st_b, xs_b)  # graftlint: disable=spmd-launch-lock
+            return out
+        """,
+    )
+    assert findings == []
+
+
+def test_launch_lock_repo_is_clean(report):
+    assert not any(
+        f.rule == "spmd-launch-lock" for f in report["all_findings"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def _stub_measure(report):
+    measured = {k: copy.deepcopy(v) for k, v in report["measured"].items()}
+
+    def stub(rule_ids=None):
+        return copy.deepcopy(measured), [], [], set()
+
+    return stub
+
+
+def test_cli_spmd_full_tree_clean(capsys, monkeypatch, report):
+    # reuse the fixture's measurements — the CLI wiring under test is
+    # budgets/baseline/exit-code plumbing, not the compiles themselves
+    monkeypatch.setattr(spmd, "measure", _stub_measure(report))
+    assert graftlint_main(["--spmd", "--root", REPO_ROOT]) == 0
+    assert "0 findings" in capsys.readouterr().out
+
+
+def test_cli_spmd_rejects_paths_and_changed_only(capsys):
+    assert graftlint_main(["--spmd", "--root", REPO_ROOT, "x.py"]) == 2
+    assert (
+        graftlint_main(["--spmd", "--root", REPO_ROOT, "--changed-only"])
+        == 2
+    )
+
+
+def test_cli_spmd_rejects_unknown_rule_id(capsys):
+    rc = graftlint_main(
+        ["--spmd", "--root", REPO_ROOT, "--rules", "spmd-collective"]
+    )
+    assert rc == 2
+    assert "unknown SPMD rule" in capsys.readouterr().err
+
+
+def test_cli_spmd_compile_error_exits_2(monkeypatch, capsys):
+    """Exit-code contract: compile errors dominate comparison findings."""
+
+    def boom(rule_ids=None):
+        return {}, [], ["spmd:fleet_solve_scan[B=8,sharded]: RuntimeError: x"], {
+            "spmd:fleet_solve_scan[B=8,sharded]"
+        }
+
+    monkeypatch.setattr(spmd, "measure", boom)
+    rc = graftlint_main(["--spmd", "--root", REPO_ROOT])
+    assert rc == 2
+    assert "compile error" in capsys.readouterr().out
+
+
+def test_cli_spmd_budget_regression_exits_1(
+    tmp_path, report, monkeypatch, capsys
+):
+    """A doctored manifest (one ceiling below the measurement) must fail
+    the CLI gate — the end-to-end positive for the budget rules."""
+    monkeypatch.setattr(spmd, "measure", _stub_measure(report))
+    entries = {
+        name: copy.deepcopy(e)
+        for name, e in report["manifest"].entries.items()
+    }
+    got = report["measured"][spmd.FLEET_ENTRY]["hbm_argument_bytes"]
+    entries[spmd.FLEET_ENTRY]["metrics"]["hbm_argument_bytes"] = got - 1
+    p = tmp_path / "kernel_budgets.json"
+    p.write_text(
+        budgets_mod.BudgetManifest.dumps({"entries": entries}),
+        encoding="utf-8",
+    )
+    rc = graftlint_main(
+        ["--spmd", "--root", REPO_ROOT, "--budgets", str(p), "--json"]
+    )
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert any(
+        "hbm_argument_bytes" in f["message"] for f in data["findings"]
+    )
+
+
+def test_cli_spmd_collective_injection_exits_1(
+    tmp_path, report, monkeypatch, capsys
+):
+    """The headline doctored fixture: a collective appearing in the
+    lane-sharded fleet program (simulated at the measurement layer —
+    the compiled-program census is exercised directly above) fails the
+    gate with an exact structure-mismatch."""
+    measured = {k: copy.deepcopy(v) for k, v in report["measured"].items()}
+    measured[spmd.FLEET_ENTRY]["collectives_all_reduce"] = 1
+
+    monkeypatch.setattr(
+        spmd,
+        "measure",
+        lambda rule_ids=None: (copy.deepcopy(measured), [], [], set()),
+    )
+    rc = graftlint_main(["--spmd", "--root", REPO_ROOT, "--json"])
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert any(
+        f["rule"] == "spmd-collectives" for f in data["findings"]
+    )
+
+
+def test_cli_spmd_donation_injection_exits_1(report, monkeypatch, capsys):
+    measured = {k: copy.deepcopy(v) for k, v in report["measured"].items()}
+    measured["spmd:solve_scan[relax=False]"]["donated_args"] = 1
+    monkeypatch.setattr(
+        spmd,
+        "measure",
+        lambda rule_ids=None: (copy.deepcopy(measured), [], [], set()),
+    )
+    rc = graftlint_main(["--spmd", "--root", REPO_ROOT, "--json"])
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert any(f["rule"] == "spmd-donation" for f in data["findings"])
+
+
+def test_cli_spmd_malformed_budgets_exits_2(tmp_path, capsys):
+    bad = tmp_path / "kernel_budgets.json"
+    bad.write_text('{"entries": {,}}', encoding="utf-8")
+    rc = graftlint_main(
+        ["--spmd", "--root", REPO_ROOT, "--budgets", str(bad)]
+    )
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "cannot parse" in err and str(bad) in err
+
+
+def test_cli_spmd_write_budgets_rejects_rule_subset(tmp_path, capsys):
+    rc = graftlint_main(
+        [
+            "--spmd",
+            "--write-budgets",
+            "--rules",
+            "spmd-hbm",
+            "--root",
+            REPO_ROOT,
+            "--budgets",
+            str(tmp_path / "b.json"),
+        ]
+    )
+    assert rc == 2
+    assert not (tmp_path / "b.json").exists()
+
+
+def test_cli_spmd_write_budgets_preserves_ir_half(
+    tmp_path, report, monkeypatch
+):
+    """`--spmd --write-budgets` rewrites only the `spmd:` entries; the
+    IR tier's half of the shared file survives byte-for-byte."""
+    monkeypatch.setattr(spmd, "measure", _stub_measure(report))
+    src = json.load(
+        open(os.path.join(REPO_ROOT, "kernel_budgets.json"), encoding="utf-8")
+    )
+    p = tmp_path / "kernel_budgets.json"
+    p.write_text(
+        budgets_mod.BudgetManifest.dumps(src), encoding="utf-8"
+    )
+    rc = graftlint_main(
+        ["--spmd", "--write-budgets", "--root", REPO_ROOT, "--budgets", str(p)]
+    )
+    assert rc == 0
+    after = json.loads(p.read_text(encoding="utf-8"))
+    ir_before = {
+        k: v
+        for k, v in src["entries"].items()
+        if not k.startswith(budgets_mod.SPMD_PREFIX)
+    }
+    ir_after = {
+        k: v
+        for k, v in after["entries"].items()
+        if not k.startswith(budgets_mod.SPMD_PREFIX)
+    }
+    assert ir_after == ir_before
+    assert set(after["entries"]) == set(src["entries"])
+
+
+def test_cli_mutually_exclusive_tier_flags(capsys):
+    assert graftlint_main(["--spmd", "--ir", "--root", REPO_ROOT]) == 2
+    assert "mutually" in capsys.readouterr().err
+
+
+def test_cli_list_rules_shows_spmd(capsys):
+    assert graftlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in spmd.SPMD_RULES:
+        assert rid in out
+    assert "[spmd]" in out
+
+
+# ---------------------------------------------------------------------------
+# --all merge (stubbed tiers: the merge/exit/seconds plumbing under test)
+
+
+def _stub_tier_reports(monkeypatch, report, spmd_findings=()):
+    import karpenter_tpu.analysis.__main__ as cli
+    from karpenter_tpu.analysis import ir, locks
+
+    flat = {
+        "findings": [],
+        "stale": [],
+        "unjustified": [],
+        "errors": [],
+        "total": 0,
+    }
+    deep = {
+        "findings": list(spmd_findings),
+        "all_findings": list(spmd_findings),
+        "stale": [],
+        "unjustified": [],
+        "budget_unjustified": [],
+        "improvements": [],
+        "errors": [],
+        "measured": {},
+    }
+    monkeypatch.setattr(cli, "run_analysis", lambda *a, **k: dict(flat))
+    monkeypatch.setattr(
+        locks, "run_race_analysis", lambda *a, **k: dict(flat)
+    )
+    monkeypatch.setattr(ir, "run_ir_analysis", lambda *a, **k: dict(deep, findings=[], all_findings=[]))
+    monkeypatch.setattr(spmd, "run_spmd_analysis", lambda *a, **k: deep)
+
+
+def test_cli_all_merges_four_tiers_with_seconds(
+    monkeypatch, capsys, report
+):
+    _stub_tier_reports(monkeypatch, report)
+    rc = graftlint_main(["--all", "--root", REPO_ROOT, "--json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert set(data) >= {"ast", "race", "ir", "spmd", "exit_code"}
+    for tier in ("ast", "race", "ir", "spmd"):
+        assert data[tier]["exit_code"] == 0
+        # the drive-by: per-tier wall-clock in the merged payload
+        assert isinstance(data[tier]["seconds"], float)
+
+
+def test_cli_all_spmd_finding_sets_worst_exit(monkeypatch, capsys, report):
+    from karpenter_tpu.analysis.engine import Finding
+
+    _stub_tier_reports(
+        monkeypatch,
+        report,
+        spmd_findings=[
+            Finding(
+                rule="spmd-collectives",
+                path="karpenter_tpu/solver/fleet.py",
+                line=1,
+                message="doctored",
+                text="spmd:x",
+            )
+        ],
+    )
+    rc = graftlint_main(["--all", "--root", REPO_ROOT, "--json"])
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["spmd"]["exit_code"] == 1
+    assert data["exit_code"] == 1
+
+
+def test_cli_all_rejects_write_and_subset_flags(capsys):
+    assert graftlint_main(["--all", "--root", REPO_ROOT, "--rules", "x"]) == 2
+    assert (
+        graftlint_main(["--all", "--root", REPO_ROOT, "--write-budgets"])
+        == 2
+    )

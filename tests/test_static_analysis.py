@@ -684,6 +684,105 @@ def test_repo_is_clean_under_graftlint():
     assert report["unjustified"] == []
 
 
+# ---------------------------------------------------------------------------
+# wire-enum-coverage (the rule reads the sibling objects.py from disk,
+# so its fixtures are tmp files, not inline sources)
+
+_WIRE_OBJECTS = """
+    import enum
+    from typing import Optional
+
+
+    class NodePhase(str, enum.Enum):
+        READY = "ready"
+
+
+    class NodeClaim:
+        phase: NodePhase
+        taint_effect: Optional[NodePhase] = None
+        name: str = ""
+"""
+
+
+def _wire_findings(tmp_path, codec_src, objects_src=_WIRE_OBJECTS):
+    api = tmp_path / "karpenter_tpu" / "api"
+    api.mkdir(parents=True)
+    (api / "objects.py").write_text(
+        textwrap.dedent(objects_src), encoding="utf-8"
+    )
+    codec = api / "codec.py"
+    codec.write_text(textwrap.dedent(codec_src), encoding="utf-8")
+    rule = next(r for r in all_rules() if r.id == "wire-enum-coverage")
+    ctx = FileContext(
+        str(codec),
+        "karpenter_tpu/api/codec.py",
+        codec.read_text(encoding="utf-8"),
+        Config(repo_root=str(tmp_path)),
+    )
+    return rule.run(ctx)
+
+
+def test_wire_enum_coverage_flags_unregistered_field(tmp_path):
+    findings = _wire_findings(
+        tmp_path,
+        """
+        _ENUM_FIELDS = {
+            "NodeClaim": {"phase": NodePhase},
+        }
+        """,
+    )
+    # `taint_effect` is enum-typed through Optional[...] but unregistered
+    # — the seed8505 shape: decodes as bare str, crashes on .value
+    assert len(findings) == 1
+    assert "taint_effect" in findings[0].message
+
+
+def test_wire_enum_coverage_negative_all_registered(tmp_path):
+    findings = _wire_findings(
+        tmp_path,
+        """
+        _ENUM_FIELDS = {
+            "NodeClaim": {"phase": NodePhase, "taint_effect": NodePhase},
+        }
+        """,
+    )
+    assert findings == []
+
+
+def test_wire_enum_coverage_flags_missing_literal(tmp_path):
+    findings = _wire_findings(tmp_path, "FIELDS = {}\n")
+    assert len(findings) == 1
+    assert "_ENUM_FIELDS" in findings[0].message
+
+
+def test_wire_enum_coverage_ignores_plain_fields(tmp_path):
+    findings = _wire_findings(
+        tmp_path,
+        """
+        _ENUM_FIELDS = {}
+        """,
+        objects_src="""
+        class NodeClaim:
+            name: str = ""
+            count: int = 0
+        """,
+    )
+    assert findings == []
+
+
+def test_wire_enum_coverage_clean_on_real_tree():
+    """The real codec registers every enum-typed api field (the contract
+    the full-tree run below also implies; pinned here for locality)."""
+    codec = os.path.join(REPO_ROOT, "karpenter_tpu", "api", "codec.py")
+    rule = next(r for r in all_rules() if r.id == "wire-enum-coverage")
+    with open(codec, encoding="utf-8") as f:
+        src = f.read()
+    ctx = FileContext(
+        codec, "karpenter_tpu/api/codec.py", src, Config(repo_root=REPO_ROOT)
+    )
+    assert rule.run(ctx) == []
+
+
 def test_every_rule_has_fixture_coverage_here():
     """Adding a rule without positive/negative fixtures fails this."""
     covered = {
@@ -697,6 +796,7 @@ def test_every_rule_has_fixture_coverage_here():
         "citation-check",
         "pytest-markers",
         "metric-naming",
+        "wire-enum-coverage",
     }
     assert {r.id for r in all_rules()} == covered
 
