@@ -1,4 +1,4 @@
-"""graftlint — the four-tier invariant analyzer for this codebase.
+"""graftlint — the five-tier invariant analyzer for this codebase.
 
 The AST tier mechanically enforces the source-level architecture
 contracts documented in CLAUDE.md and the gate comments atop
@@ -34,12 +34,26 @@ aot_manifest.json cost catalog, a buffer-donation census, and the
 launch-lock AST rule (sharded dispatches inside `_MESH_DISPATCH_LOCK`
 with the result fetch).
 
+The protocol tier (analysis/proto.py, `--proto`) model-checks the
+solver wire/epoch/breaker state machines: small executable models of
+the SolverClient request lifecycle, the SolverServer handler (admission
+gate, drain, epoch store), and the CircuitBreaker, composed over a
+fault-capable channel (drop/truncate/duplicate/reorder/kill, mirroring
+testing/faults.py), explored by bounded breadth-first search with
+canonical-state dedup. Counterexamples are shrunk to the shortest fault
+schedule and pinned in tests/proto_corpus/. Its conformance half
+(analysis/protorec.py) records real frame/breaker traces — across the
+whole `faults`-marked pytest suite and two live scenarios the tier
+drives itself — and verifies each trace refines the model.
+
 Importing THIS package MUST NOT import JAX or numpy
 (tests/test_static_analysis.py pins this) — the AST gate runs in seconds
 with no device/tunnel involvement; only analysis/ir.py and
 analysis/spmd.py import JAX, and only when loaded explicitly (the CLI
 does so under `--ir`/`--spmd`). The race tier's both halves are
-stdlib-only too (tests/test_race_analysis.py pins that).
+stdlib-only too (tests/test_race_analysis.py pins that), as are the
+protocol tier's model and recorder (its live-conformance scenarios
+import the solver stack lazily, inside `--proto` runs only).
 
 Usage:
     python -m karpenter_tpu.analysis            # AST: lint package + tests
@@ -48,7 +62,9 @@ Usage:
     python -m karpenter_tpu.analysis --ir       # IR: trace kernels + budgets
     python -m karpenter_tpu.analysis --race     # race tier, static half
     python -m karpenter_tpu.analysis --spmd     # SPMD: compile + census
+    python -m karpenter_tpu.analysis --proto    # protocol: model + traces
     python -m karpenter_tpu.analysis --all      # every tier, worst exit code
+    python -m karpenter_tpu.analysis --all --jobs 3   # tiers in parallel
 
 Rules, suppression syntax (`# graftlint: disable=<rule>`), the baseline
 workflow, and the budget manifest are documented in

@@ -1,7 +1,7 @@
 """graftlint CLI: `python -m karpenter_tpu.analysis` (also installed as
 the `graftlint` console script).
 
-Four tiers share this entry point:
+Five tiers share this entry point:
 
 - the AST tier (default): stdlib-`ast` source analysis, JAX-free;
 - the IR tier (`--ir`): traces the real solver kernels and walks the
@@ -18,10 +18,18 @@ Four tiers share this entry point:
   collective census, per-device HBM ceilings, donation census (the
   `spmd:` half of kernel_budgets.json) plus the launch-lock AST rule.
   The CLI pins the virtual mesh env BEFORE the first jax import.
+- the protocol tier (`--proto`): explicit-state model checking of the
+  solver wire/epoch/breaker state machines under channel faults
+  (analysis/proto.py), plus live conformance — it drives the REAL
+  ResilientSolver and a REAL drained SolverServer under the
+  analysis/protorec.py trace recorder and verifies the recorded traces
+  refine the model. Counterexamples ship as shrunk, replayable fault
+  schedules (tests/proto_corpus/).
 
-`--all` runs every tier (AST + race + IR + SPMD) with merged `--json`
-output, per-tier wall-clock seconds, and a single worst-case exit code
-— the one-command CI gate.
+`--all` runs every tier (AST + race + IR + SPMD + proto) with merged
+`--json` output, per-tier wall-clock seconds, and a single worst-case
+exit code — the one-command CI gate; `--jobs N` runs the tiers in up
+to N worker threads.
 
 Exit codes: 0 clean (baseline-covered findings allowed), 1 findings or
 stale/unjustified baseline or budget entries, 2 usage/parse/trace errors.
@@ -38,6 +46,7 @@ import time
 
 from karpenter_tpu.analysis.engine import (
     IR_DEFAULT_BASELINE,
+    PROTO_DEFAULT_BASELINE,
     SPMD_DEFAULT_BASELINE,
     Baseline,
     all_rules,
@@ -216,10 +225,26 @@ def main(argv=None) -> int:
         "launch-lock rule (imports JAX; see docs/static-analysis.md)",
     )
     parser.add_argument(
+        "--proto",
+        action="store_true",
+        help="run the protocol tier: explicit-state model checking of "
+        "the wire/epoch/breaker state machines under channel faults, "
+        "plus live conformance against the real client/server/breaker "
+        "(imports the solver stack; see docs/static-analysis.md)",
+    )
+    parser.add_argument(
         "--all",
         action="store_true",
-        help="run every tier (AST + race + IR + SPMD) with merged --json "
-        "output, per-tier seconds, and a single worst-case exit code",
+        help="run every tier (AST + race + IR + SPMD + proto) with "
+        "merged --json output, per-tier seconds, and a single "
+        "worst-case exit code",
+    )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        help="with --all: run the tiers in up to N worker threads "
+        "(default 1, sequential; per-tier seconds stay wall-clock)",
     )
     parser.add_argument(
         "--budgets",
@@ -239,6 +264,7 @@ def main(argv=None) -> int:
             print(f"{r.id:20s} {r.summary}")
         from karpenter_tpu.analysis.ir import IR_RULES
         from karpenter_tpu.analysis.locks import RACE_RULES
+        from karpenter_tpu.analysis.proto import PROTO_RULES
         from karpenter_tpu.analysis.spmd import SPMD_RULES
 
         for rid, summary in IR_RULES.items():
@@ -247,6 +273,8 @@ def main(argv=None) -> int:
             print(f"{rid:20s} [race] {summary}")
         for rid, summary in SPMD_RULES.items():
             print(f"{rid:20s} [spmd] {summary}")
+        for rid, summary in PROTO_RULES.items():
+            print(f"{rid:20s} [proto] {summary}")
         return 0
 
     repo_root = os.path.abspath(args.root or _detect_repo_root())
@@ -262,6 +290,7 @@ def main(argv=None) -> int:
             ("--ir", args.ir or (args.write_budgets and not args.spmd)),
             ("--race", args.race),
             ("--spmd", args.spmd),
+            ("--proto", args.proto),
         )
         if on
     ]
@@ -273,8 +302,21 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.jobs != 1 and not args.all:
+        # an explicitly passed option that does nothing must be refused:
+        # a single-tier run has no tiers to parallelize
+        print(
+            "graftlint: --jobs only applies to --all",
+            file=sys.stderr,
+        )
+        return 2
+    if args.jobs < 1:
+        print("graftlint: --jobs must be >= 1", file=sys.stderr)
+        return 2
     if args.all:
         return _main_all(args, repo_root)
+    if args.proto:
+        return _main_proto(args, repo_root)
     if args.spmd:
         return _main_spmd(args, repo_root)
     if args.write_budgets:
@@ -776,11 +818,104 @@ def _main_race(args: argparse.Namespace, repo_root: str) -> int:
     return 0
 
 
+def _main_proto(args: argparse.Namespace, repo_root: str) -> int:
+    """The `--proto` tier (analysis/proto.py): model-check the wire/
+    epoch/breaker protocol under channel faults and refinement-check
+    live traces of the real code, under graftlint.proto.baseline.json."""
+    if args.paths or args.changed_only:
+        # the protocol is a property of the composed client/server/
+        # breaker machines, not of files — a path subset has no meaning
+        # and must not read as a clean run
+        print(
+            "graftlint: --proto model-checks the wire protocol; it "
+            "takes no paths and no --changed-only",
+            file=sys.stderr,
+        )
+        return 2
+    if args.rules or args.budgets or args.reference_root != _DEFAULT_REFERENCE_ROOT:
+        # an explicitly passed option that does nothing must be refused:
+        # the properties are checked in ONE exploration per scenario —
+        # there is no per-rule subset to run (and no budget manifest)
+        print(
+            "graftlint: --rules/--budgets/--reference-root are not used "
+            "by --proto (every protocol property rides one exploration)",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        from karpenter_tpu.analysis import proto as proto_mod
+    except ImportError as e:
+        print(f"graftlint: protocol tier unavailable ({e})", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(
+        repo_root, PROTO_DEFAULT_BASELINE
+    )
+    if not _json_files_parse(baseline_path):
+        return 2
+
+    report = proto_mod.run_proto_analysis(
+        repo_root, baseline_path=baseline_path
+    )
+
+    if args.write_baseline:
+        if report["errors"]:
+            # a crashed live scenario means the conformance half never
+            # ran; rewriting from the partial result would bless it
+            for e in report["errors"]:
+                print(f"scenario error: {e}", file=sys.stderr)
+            return 2
+        return _write_baseline_file(baseline_path, report["all_findings"])
+
+    findings = report["findings"]
+    stale = report["stale"]
+    unjustified = report["unjustified"]
+    errors = report["errors"]
+
+    baselined = len(report["all_findings"]) - len(findings)
+    if args.json:
+        payload = _tier_payload(findings, stale, unjustified, errors, baselined)
+        payload["scenarios"] = report["scenarios"]
+        payload["properties"] = report["properties"]
+        payload["conformance"] = report["conformance"]
+        print(json.dumps(payload, indent=2))
+    else:
+        _print_report_entries(findings, stale, unjustified)
+        for e in errors:
+            print(f"scenario error: {e}")
+        states = sum(s["states"] for s in report["scenarios"].values())
+        truncated = [
+            n for n, s in report["scenarios"].items() if s["truncated"]
+        ]
+        print(
+            f"graftlint --proto: {len(findings)} finding"
+            f"{'' if len(findings) == 1 else 's'}, "
+            f"{states} states over {len(report['scenarios'])} scenario(s), "
+            f"{len(report['conformance'])} live trace(s)"
+            + (f", {baselined} baselined" if baselined else "")
+            + (
+                f", truncated: {', '.join(truncated)}"
+                if truncated
+                else ""
+            )
+        )
+
+    if errors:
+        # a live scenario that no longer runs is a broken gate, not a
+        # lint verdict — exit 2 even when model findings also exist
+        return 2
+    if findings or stale or unjustified:
+        return 1
+    return 0
+
+
 def _main_all(args: argparse.Namespace, repo_root: str) -> int:
-    """`--all`: AST + race + IR + SPMD in one invocation, merged
-    `--json` output with per-tier wall-clock seconds, worst-case exit
-    code (2 > 1 > 0). Read-only by design — the write modes stay
-    per-tier so a rewrite is always an explicit, single-tier act."""
+    """`--all`: AST + race + IR + SPMD + proto in one invocation,
+    merged `--json` output with per-tier wall-clock seconds, worst-case
+    exit code (2 > 1 > 0). Read-only by design — the write modes stay
+    per-tier so a rewrite is always an explicit, single-tier act.
+    `--jobs N` runs the tiers in up to N worker threads; the payload
+    order and each tier's call style are identical either way."""
     if (
         args.paths
         or args.changed_only
@@ -810,6 +945,7 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
         os.path.join(repo_root, locks.DEFAULT_BASELINE),
         os.path.join(repo_root, IR_DEFAULT_BASELINE),
         os.path.join(repo_root, SPMD_DEFAULT_BASELINE),
+        os.path.join(repo_root, PROTO_DEFAULT_BASELINE),
     ]
     try:
         from karpenter_tpu.analysis import budgets as _budgets_preflight
@@ -832,9 +968,6 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
     except ImportError:
         spmd_mod = None  # the tier reports itself unavailable below
 
-    payload: dict = {}
-    codes: list[int] = []
-
     def _tier_code(report: dict, extra_unjustified: int = 0) -> int:
         if (
             report["findings"]
@@ -847,78 +980,83 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
             return 2
         return 0
 
-    t0 = time.monotonic()
-    ast_report = run_analysis(repo_root, reference_root=args.reference_root)
-    codes.append(_tier_code(ast_report))
-    payload["ast"] = _tier_payload(
-        ast_report["findings"],
-        ast_report["stale"],
-        ast_report["unjustified"],
-        ast_report["errors"],
-        ast_report["total"] - len(ast_report["findings"]),
-    )
-    payload["ast"]["exit_code"] = codes[-1]
-    payload["ast"]["seconds"] = round(time.monotonic() - t0, 3)
+    # each tier is one thunk returning its finished payload (exit_code
+    # included); the driver below runs them sequentially or in a worker
+    # pool (--jobs) with IDENTICAL call styles, so the per-tier report
+    # shapes — and the tests that stub run_analysis & friends — cannot
+    # drift between the two paths. Per-tier `seconds` stays wall-clock
+    # inside the thunk: under --jobs it reports that tier's own runtime,
+    # not the pool's.
 
-    t0 = time.monotonic()
-    race_report = locks.run_race_analysis(repo_root)
-    # parse errors make the whole-program claim false: broken gate (2),
-    # mirroring the IR tier's trace-error convention below
-    codes.append(2 if race_report["errors"] else _tier_code(race_report))
-    payload["race"] = _tier_payload(
-        race_report["findings"],
-        race_report["stale"],
-        race_report["unjustified"],
-        race_report["errors"],
-        race_report["total"] - len(race_report["findings"]),
-    )
-    payload["race"]["exit_code"] = codes[-1]
-    payload["race"]["seconds"] = round(time.monotonic() - t0, 3)
+    def _run_ast() -> dict:
+        ast_report = run_analysis(
+            repo_root, reference_root=args.reference_root
+        )
+        out = _tier_payload(
+            ast_report["findings"],
+            ast_report["stale"],
+            ast_report["unjustified"],
+            ast_report["errors"],
+            ast_report["total"] - len(ast_report["findings"]),
+        )
+        out["exit_code"] = _tier_code(ast_report)
+        return out
 
-    t0 = time.monotonic()
-    try:
-        from karpenter_tpu.analysis import budgets as budgets_mod
-        from karpenter_tpu.analysis import ir
+    def _run_race() -> dict:
+        race_report = locks.run_race_analysis(repo_root)
+        out = _tier_payload(
+            race_report["findings"],
+            race_report["stale"],
+            race_report["unjustified"],
+            race_report["errors"],
+            race_report["total"] - len(race_report["findings"]),
+        )
+        # parse errors make the whole-program claim false: broken gate
+        # (2), mirroring the IR tier's trace-error convention
+        out["exit_code"] = (
+            2 if race_report["errors"] else _tier_code(race_report)
+        )
+        return out
 
+    def _run_ir() -> dict:
+        try:
+            from karpenter_tpu.analysis import budgets as budgets_mod
+            from karpenter_tpu.analysis import ir
+        except ImportError as e:
+            return {"unavailable": str(e), "exit_code": 2}
         ir_report = ir.run_ir_analysis(
             repo_root,
             budgets_path=os.path.join(repo_root, budgets_mod.DEFAULT_MANIFEST),
             baseline_path=os.path.join(repo_root, IR_DEFAULT_BASELINE),
         )
-        # mirror _main_ir: a kernel that no longer traces is a broken
-        # gate (2), even when comparison findings also exist
-        ir_code = (
-            2
-            if ir_report["errors"]
-            else _tier_code(
-                ir_report, extra_unjustified=len(ir_report["budget_unjustified"])
-            )
-        )
-        codes.append(ir_code)
-        payload["ir"] = _tier_payload(
+        out = _tier_payload(
             ir_report["findings"],
             ir_report["stale"],
             ir_report["unjustified"],
             ir_report["errors"],
             len(ir_report["all_findings"]) - len(ir_report["findings"]),
         )
-        payload["ir"]["unjustified_budgets"] = ir_report["budget_unjustified"]
-        payload["ir"]["improvements"] = ir_report["improvements"]
-        payload["ir"]["measured"] = ir_report["measured"]
-        payload["ir"]["exit_code"] = ir_code
-    except ImportError as e:
-        codes.append(2)
-        payload["ir"] = {"unavailable": str(e), "exit_code": 2}
-    payload["ir"]["seconds"] = round(time.monotonic() - t0, 3)
+        out["unjustified_budgets"] = ir_report["budget_unjustified"]
+        out["improvements"] = ir_report["improvements"]
+        out["measured"] = ir_report["measured"]
+        # mirror _main_ir: a kernel that no longer traces is a broken
+        # gate (2), even when comparison findings also exist
+        out["exit_code"] = (
+            2
+            if ir_report["errors"]
+            else _tier_code(
+                ir_report,
+                extra_unjustified=len(ir_report["budget_unjustified"]),
+            )
+        )
+        return out
 
-    t0 = time.monotonic()
-    if spmd_mod is None:
-        codes.append(2)
-        payload["spmd"] = {
-            "unavailable": "karpenter_tpu.analysis.spmd failed to import",
-            "exit_code": 2,
-        }
-    else:
+    def _run_spmd() -> dict:
+        if spmd_mod is None:
+            return {
+                "unavailable": "karpenter_tpu.analysis.spmd failed to import",
+                "exit_code": 2,
+            }
         spmd_report = spmd_mod.run_spmd_analysis(
             repo_root,
             budgets_path=os.path.join(
@@ -926,9 +1064,19 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
             ),
             baseline_path=os.path.join(repo_root, SPMD_DEFAULT_BASELINE),
         )
+        out = _tier_payload(
+            spmd_report["findings"],
+            spmd_report["stale"],
+            spmd_report["unjustified"],
+            spmd_report["errors"],
+            len(spmd_report["all_findings"]) - len(spmd_report["findings"]),
+        )
+        out["unjustified_budgets"] = spmd_report["budget_unjustified"]
+        out["improvements"] = spmd_report["improvements"]
+        out["measured"] = spmd_report["measured"]
         # mirror _main_spmd: a program that no longer compiles is a
         # broken gate (2), even when comparison findings also exist
-        spmd_code = (
+        out["exit_code"] = (
             2
             if spmd_report["errors"]
             else _tier_code(
@@ -936,28 +1084,89 @@ def _main_all(args: argparse.Namespace, repo_root: str) -> int:
                 extra_unjustified=len(spmd_report["budget_unjustified"]),
             )
         )
-        codes.append(spmd_code)
-        payload["spmd"] = _tier_payload(
-            spmd_report["findings"],
-            spmd_report["stale"],
-            spmd_report["unjustified"],
-            spmd_report["errors"],
-            len(spmd_report["all_findings"]) - len(spmd_report["findings"]),
-        )
-        payload["spmd"]["unjustified_budgets"] = spmd_report[
-            "budget_unjustified"
-        ]
-        payload["spmd"]["improvements"] = spmd_report["improvements"]
-        payload["spmd"]["measured"] = spmd_report["measured"]
-        payload["spmd"]["exit_code"] = spmd_code
-    payload["spmd"]["seconds"] = round(time.monotonic() - t0, 3)
+        return out
 
-    worst = max(codes)
+    def _run_proto() -> dict:
+        try:
+            from karpenter_tpu.analysis import proto as proto_mod
+        except ImportError as e:
+            return {"unavailable": str(e), "exit_code": 2}
+        proto_report = proto_mod.run_proto_analysis(
+            repo_root,
+            baseline_path=os.path.join(repo_root, PROTO_DEFAULT_BASELINE),
+        )
+        out = _tier_payload(
+            proto_report["findings"],
+            proto_report["stale"],
+            proto_report["unjustified"],
+            proto_report["errors"],
+            len(proto_report["all_findings"]) - len(proto_report["findings"]),
+        )
+        out["scenarios"] = proto_report["scenarios"]
+        out["properties"] = proto_report["properties"]
+        out["conformance"] = proto_report["conformance"]
+        # mirror _main_proto: a live scenario that no longer runs is a
+        # broken gate (2), even when model findings also exist
+        out["exit_code"] = (
+            2 if proto_report["errors"] else _tier_code(proto_report)
+        )
+        return out
+
+    tiers = (
+        ("ast", _run_ast),
+        ("race", _run_race),
+        ("ir", _run_ir),
+        ("spmd", _run_spmd),
+        ("proto", _run_proto),
+    )
+
+    def _timed(fn):
+        t0 = time.monotonic()
+        try:
+            out = fn()
+        except Exception as e:  # a crashed tier is a broken gate, not a pass
+            out = {"unavailable": f"{type(e).__name__}: {e}", "exit_code": 2}
+        out["seconds"] = round(time.monotonic() - t0, 3)
+        return out
+
+    payload: dict = {}
+    if args.jobs > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        # The IR and SPMD tiers both trace/compile JAX programs in THIS
+        # process, and the IR tier's retrace accounting reads the
+        # process-global trace counter — another tier compiling inside
+        # its measurement window manufactures phantom ir-retrace
+        # regressions. The two JAX tiers therefore share one worker
+        # (serialized against each other, in tier order); the
+        # stdlib-only tiers (ast, race, proto) parallelize freely.
+        jax_tiers = ("ir", "spmd")
+        fns = dict(tiers)
+
+        def _run_jax_chain() -> dict:
+            return {name: _timed(fns[name]) for name in jax_tiers}
+
+        with ThreadPoolExecutor(max_workers=args.jobs) as pool:
+            chain = pool.submit(_run_jax_chain)
+            futures = [
+                (name, pool.submit(_timed, fn))
+                for name, fn in tiers
+                if name not in jax_tiers
+            ]
+            for name, fut in futures:
+                payload[name] = fut.result()
+            payload.update(chain.result())
+        payload = {name: payload[name] for name, _ in tiers}
+    else:
+        for name, fn in tiers:
+            payload[name] = _timed(fn)
+
+    worst = max(payload[name]["exit_code"] for name, _ in tiers)
     if args.json:
         payload["exit_code"] = worst
         print(json.dumps(payload, indent=2))
     else:
-        for tier in ("ast", "race", "ir", "spmd"):
+        for tier in ("ast", "race", "ir", "spmd", "proto"):
             rep = payload[tier]
             if "unavailable" in rep:
                 print(f"[{tier}] unavailable: {rep['unavailable']}")

@@ -65,6 +65,10 @@ IR_DEFAULT_BASELINE = "graftlint.ir.baseline.json"
 # the SPMD tier's baseline, hoisted for the same reason (spmd.py
 # compiles real sharded programs and imports JAX)
 SPMD_DEFAULT_BASELINE = "graftlint.spmd.baseline.json"
+# the protocol tier's baseline (analysis/proto.py is stdlib-only, but
+# its live-conformance scenarios import the real solver stack, so the
+# CLI preflight names the file from here like the other deferred tiers)
+PROTO_DEFAULT_BASELINE = "graftlint.proto.baseline.json"
 
 
 @dataclasses.dataclass

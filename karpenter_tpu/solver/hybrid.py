@@ -46,6 +46,7 @@ from typing import Optional
 
 from karpenter_tpu import logging as klog
 from karpenter_tpu import metrics
+from karpenter_tpu.analysis import protorec
 from karpenter_tpu.api.objects import NodePool, Pod
 from karpenter_tpu.cloudprovider.types import InstanceTypes
 from karpenter_tpu.solver.epochs import SolverOverloaded
@@ -402,6 +403,21 @@ class CircuitBreaker:
             _BREAKER_STATE_CODES[self.state], {"breaker": self.name}
         )
 
+    def _record_locked(self, ev: str, **fields) -> None:
+        """Protocol-tier conformance event, emitted under the breaker
+        lock so the recorded transition order IS the real one (the
+        refinement acceptor in analysis/proto.py checks each event's
+        pre/post state legality; analysis/protorec.py docstring covers
+        the free-when-off contract)."""
+        if protorec.RECORDER is not None:
+            protorec.RECORDER.record(
+                ev=ev,
+                state=self.state,
+                failures=self.consecutive_failures,
+                threshold=self.failure_threshold,
+                **fields,
+            )
+
     def allow(self) -> bool:
         """May the next solve attempt the sidecar? Half-open admits ONE
         probe: the open->half-open transition returns True exactly once
@@ -415,28 +431,40 @@ class CircuitBreaker:
         now = self._clock()
         with self._lock:
             if self.state == "closed":
+                self._record_locked("breaker_allow", granted=True, probe=False)
                 return True
             if self.state == "half-open":
                 if now - self._probe_at >= self.cooldown_seconds:
                     self._probe_at = now  # lost probe; this caller takes over
+                    self._record_locked(
+                        "breaker_allow", granted=True, probe=True, takeover=True
+                    )
                     return True
+                self._record_locked("breaker_allow", granted=False, probe=False)
                 return False  # a probe is already in flight
             if now - self._opened_at >= self.cooldown_seconds:
                 self.state = "half-open"
                 self._probe_at = now
                 self._publish_locked()
+                self._record_locked(
+                    "breaker_allow", granted=True, probe=True, takeover=False
+                )
                 return True
+            self._record_locked("breaker_allow", granted=False, probe=False)
             return False
 
     def record_success(self) -> None:
         with self._lock:
+            prev = self.state
             self.state = "closed"
             self.consecutive_failures = 0
             self._opened_at = None
             self._publish_locked()
+            self._record_locked("breaker_success", prev=prev)
 
     def record_failure(self) -> None:
         with self._lock:
+            prev = self.state
             self.consecutive_failures += 1
             if (
                 self.state == "half-open"
@@ -445,6 +473,7 @@ class CircuitBreaker:
                 self.state = "open"
                 self._opened_at = self._clock()
             self._publish_locked()
+            self._record_locked("breaker_failure", prev=prev)
 
 
 class RemoteNodeClaim:
@@ -544,6 +573,17 @@ class ResilientSolver:
                 force_oracle, tr,
             )
 
+    def _record_attempt(self, outcome: str) -> None:
+        """Protocol-tier conformance event: one per solve attempt, AFTER
+        the breaker verdict that resolves it — the refinement acceptor
+        requires e.g. an `overloaded` outcome to carry a breaker_success
+        on the same thread (the RETRY-records-success rule this module's
+        admission-rejection branch pins)."""
+        if protorec.RECORDER is not None:
+            protorec.RECORDER.record(
+                ev="attempt", outcome=outcome, breaker=self.breaker.state
+            )
+
     def _solve_traced(
         self,
         node_pools,
@@ -595,6 +635,7 @@ class ResilientSolver:
                         trace=tr,
                     )
                 self.breaker.record_success()
+                self._record_attempt("success")
                 SIDECAR_REQUESTS.inc({"outcome": "success"})
                 self.last_used = "sidecar"
                 self.fallback_reason = None
@@ -610,6 +651,7 @@ class ResilientSolver:
                 # cooldown per lost-probe recovery. Pacing is the
                 # admission backoff's job, not the breaker's.
                 self.breaker.record_success()
+                self._record_attempt("overloaded")
                 self._admission_retry_at = self._clock() + max(
                     0.0, e.backoff_hint_seconds
                 )
@@ -632,6 +674,7 @@ class ResilientSolver:
                 )
             except Exception as e:
                 self.breaker.record_failure()
+                self._record_attempt("failure")
                 SIDECAR_REQUESTS.inc({"outcome": "failure"})
                 SOLVER_FALLBACK.inc({"reason": "sidecar_unavailable"})
                 self.fallback_reason = (
@@ -650,12 +693,14 @@ class ResilientSolver:
                     breaker=self.breaker.state,
                 )
         elif in_backoff:
+            self._record_attempt("backoff")
             SOLVER_FALLBACK.inc({"reason": "admission_rejected"})
             self.fallback_reason = (
                 "sidecar admission backoff in effect; solving in-process"
             )
             tr.event("admission_backoff")
         else:
+            self._record_attempt("breaker_denied")
             SOLVER_FALLBACK.inc({"reason": "circuit_open"})
             self.fallback_reason = (
                 "sidecar circuit open; solving in-process during cooldown"

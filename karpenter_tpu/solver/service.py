@@ -105,6 +105,7 @@ import numpy as np
 
 from karpenter_tpu import logging as klog
 from karpenter_tpu import tracing
+from karpenter_tpu.analysis import protorec
 from karpenter_tpu.api import codec
 from karpenter_tpu.solver import epochs
 from karpenter_tpu.solver.hybrid import solve_in_process
@@ -831,6 +832,15 @@ class SolverServer:
             # once (best effort, the header's req_id if it was readable)
             # and close only this connection
             self.log.warn("protocol violation, closing connection", error=str(e))
+            if protorec.RECORDER is not None:
+                protorec.RECORDER.record(
+                    ev="srv_send",
+                    kind=KIND_ERROR,
+                    req_id=e.req_id,
+                    conn=protorec.RECORDER.conn_id(conn),
+                    draining=self._stop.is_set(),
+                    refusal=False,
+                )
             try:
                 _send_frame(conn, KIND_ERROR, str(e).encode(), req_id=e.req_id)
             except OSError:
@@ -841,6 +851,12 @@ class SolverServer:
                 error=f"{type(e).__name__}: {e}",
             )
         finally:
+            if protorec.RECORDER is not None:
+                protorec.RECORDER.record(
+                    ev="srv_close",
+                    conn=protorec.RECORDER.conn_closed(conn),
+                    draining=self._stop.is_set(),
+                )
             conn.close()
             with self._conns_lock:
                 self._conns.discard(threading.current_thread())
@@ -897,12 +913,36 @@ class SolverServer:
                 )
             _discard_exact_deadline(conn, length, deadline)
             raise _OversizedFrame(req_id, length)
-        return kind, req_id, _recv_exact_deadline(conn, length, deadline)
+        payload = _recv_exact_deadline(conn, length, deadline)
+        if protorec.RECORDER is not None:
+            # a COMPLETE frame arrived: everything the server hears is on
+            # the record — a received solve that closes unanswered is the
+            # silent-drain-close violation the refinement acceptor hunts
+            protorec.RECORDER.record(
+                ev="srv_recv",
+                kind=kind,
+                req_id=req_id,
+                conn=protorec.RECORDER.conn_id(conn),
+                draining=self._stop.is_set(),
+            )
+        return kind, req_id, payload
 
     def _send_response(self, conn: socket.socket, kind: int, payload: bytes, req_id: int) -> None:
         """A peer that stops READING must not wedge the handler either:
         sendall under a socket timeout enforces a total wall-clock bound
         across its internal retries (CPython tracks a deadline)."""
+        if protorec.RECORDER is not None:
+            # record the INTENT before the write: a peer closed by a
+            # fault mid-send must still count as "answered" — the server
+            # held up its half of the contract
+            protorec.RECORDER.record(
+                ev="srv_send",
+                kind=kind,
+                req_id=req_id,
+                conn=protorec.RECORDER.conn_id(conn),
+                draining=self._stop.is_set(),
+                refusal=kind == KIND_ERROR and payload.startswith(b"draining"),
+            )
         conn.settimeout(FRAME_STALL_SECONDS)
         _send_frame(conn, kind, payload, req_id=req_id)
 
@@ -1148,6 +1188,19 @@ class SolverServer:
             current = gen0 == self._epoch_gen
         if current:
             self.epochs.put(str(client), epoch_id, sections)
+            if protorec.RECORDER is not None:
+                protorec.RECORDER.record(
+                    ev="srv_epoch_store", client=str(client), epoch=epoch_id
+                )
+        elif protorec.RECORDER is not None:
+            # a DELIBERATE drop (stale generation) is a legal trace: the
+            # client may still commit this epoch off the RESULT, and the
+            # next delta heals through one EPOCH_RESYNC — the refinement
+            # acceptor accepts a commit against a store OR a recorded
+            # skip, but never against silence
+            protorec.RECORDER.record(
+                ev="srv_epoch_store_skipped", client=str(client), epoch=epoch_id
+            )
 
     def _solve_decoded(self, decoded: tuple, tr, epoch_key=None) -> bytes:
         (
@@ -1355,6 +1408,14 @@ class SolverClient:
                         f"correlation mismatch: sent {req_id}, got {rid} — "
                         "stream poisoned, tearing down"
                     )
+                if protorec.RECORDER is not None:
+                    protorec.RECORDER.record(
+                        ev="cli_roundtrip",
+                        client=self.client_id,
+                        kind=kind,
+                        resp_kind=rkind,
+                        req_id=req_id,
+                    )
                 return rkind, resp
             except socket.timeout as e:
                 # a partial read after timeout leaves the response in
@@ -1524,6 +1585,13 @@ class SolverClient:
                     self._acked_epoch = body["epoch"]
                     self._acked_sections = sections
                     self.delta_solves += 1
+                    if protorec.RECORDER is not None:
+                        protorec.RECORDER.record(
+                            ev="cli_epoch_commit",
+                            client=self.client_id,
+                            epoch=body["epoch"],
+                            mode="delta",
+                        )
                     return out
 
         # full snapshot, establishing (or re-establishing) an epoch
@@ -1538,4 +1606,11 @@ class SolverClient:
         self._acked_epoch = self._epoch_seq
         self._acked_sections = sections
         self.full_solves += 1
+        if protorec.RECORDER is not None:
+            protorec.RECORDER.record(
+                ev="cli_epoch_commit",
+                client=self.client_id,
+                epoch=self._epoch_seq,
+                mode="snapshot",
+            )
         return out

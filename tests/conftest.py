@@ -103,3 +103,35 @@ def _racert_witness(request):
         racert.uninstrument()
     witness.assert_no_inversions()
     witness.assert_no_thread_exceptions()
+
+
+# Protocol-trace conformance witness (karpenter_tpu/analysis/protorec.py +
+# proto.check_refinement): every `faults`-marked test records the real
+# wire/breaker events its fault schedule provokes, and the recorded trace
+# must refine the protocol model — breaker transition legality and probe
+# obligations, the drain answer-then-close bound, epoch
+# commit-implies-store, the resync one-hop rule. The fault matrix thus
+# doubles as a model-conformance suite on every tier-1 run (the racert
+# pattern, one layer up the stack). Opt in from any other test with
+# @pytest.mark.proto. Overhead when not recording is one module-attribute
+# load per hook site (tests/test_proto_analysis.py pins it).
+@pytest.fixture(autouse=True)
+def _proto_conformance(request):
+    if (
+        request.node.get_closest_marker("faults") is None
+        and request.node.get_closest_marker("proto") is None
+    ):
+        yield
+        return
+    from karpenter_tpu.analysis import proto, protorec
+
+    recorder = protorec.install()
+    try:
+        yield recorder
+    finally:
+        protorec.uninstall()
+    violations = proto.check_refinement(recorder.snapshot())
+    assert not violations, (
+        "recorded protocol trace does not refine the model "
+        "(analysis/proto.py):\n" + "\n".join(violations)
+    )

@@ -1,0 +1,1037 @@
+"""graftlint protocol tier gate (analysis/proto.py + analysis/protorec.py):
+corpus replay FIRST (every pinned counterexample still reproduces its
+violation), canonical-dedup and BFS-shortest properties of the explorer,
+the broken-knob matrix (each deliberately-broken model finds exactly its
+property; the real knobs stay clean), model-trace refinement in both
+directions, the refinement acceptors on hand-built traces, the two live
+conformance scenarios, the recorder's zero-disabled-cost contract, the
+CLI exit codes, and the five-tier `--all --jobs` merge.
+
+The module-scoped `report` fixture does the expensive work once: the
+full five-scenario exploration plus both live scenarios — the same run
+`graftlint --proto` performs. Everything else is doctored-input unit
+tests on the model, the acceptors, and the CLI plumbing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import glob
+import inspect
+import json
+import os
+import time
+from collections import deque
+
+import pytest
+
+from karpenter_tpu.analysis import proto, protorec
+from karpenter_tpu.analysis.__main__ import main as graftlint_main
+from karpenter_tpu.analysis.engine import Finding
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS_DIR = os.path.join(REPO_ROOT, "tests", "proto_corpus")
+CORPUS_FILES = sorted(glob.glob(os.path.join(CORPUS_DIR, "*.json")))
+
+
+def _cfg(scenario_name: str, knobs: proto.Knobs) -> proto.Config:
+    scn = next(s for s in proto.SCENARIOS if s.name == scenario_name)
+    return proto.Config(knobs, scn)
+
+
+# ---------------------------------------------------------------------------
+# corpus replay — FIRST: a pinned counterexample that stops reproducing
+# means the model (or the property) drifted from what the corpus froze
+
+
+@pytest.mark.parametrize(
+    "path", CORPUS_FILES, ids=[os.path.basename(p) for p in CORPUS_FILES]
+)
+def test_corpus_case_still_violates(path):
+    with open(path, encoding="utf-8") as fh:
+        case = json.load(fh)
+    assert case["rule"] in proto.replay_corpus_case(case), (
+        f"{os.path.basename(path)}: the pinned schedule no longer "
+        f"violates {case['rule']} — the model drifted from the corpus"
+    )
+    # the filename IS the (rule, scenario) key the emitter writes
+    assert os.path.basename(path) == f"{case['rule']}__{case['scenario']}.json"
+    assert case["repro"] == proto.REPRO_HINT
+
+
+def test_corpus_covers_every_broken_knob():
+    pinned = {os.path.basename(p).split("__")[0] for p in CORPUS_FILES}
+    assert pinned == set(proto.BROKEN_KNOBS), (
+        "every property's broken-knob counterexample must be pinned in "
+        "tests/proto_corpus/ (regenerate with proto.emit_counterexample)"
+    )
+
+
+def test_corpus_serialization_is_canonical(tmp_path):
+    """Re-emitting a pinned case is byte-identical: sorted keys, indent
+    2, trailing newline — so corpus churn in review is always a real
+    schedule change, never serializer noise."""
+    for path in CORPUS_FILES:
+        with open(path, encoding="utf-8") as fh:
+            case = json.load(fh)
+        ce = proto.Counterexample(
+            rule=case["rule"],
+            scenario=case["scenario"],
+            knobs=proto.Knobs(**case["knobs"]),
+            schedule=case["schedule"],
+            message=case["message"],
+        )
+        out = proto.emit_counterexample(ce, str(tmp_path))
+        with open(out, "rb") as fh_new, open(path, "rb") as fh_old:
+            assert fh_new.read() == fh_old.read(), os.path.basename(path)
+
+
+# ---------------------------------------------------------------------------
+# the full tier run (module-scoped: the gate `graftlint --proto` enforces)
+
+
+@pytest.fixture(scope="module")
+def report():
+    t0 = time.monotonic()
+    rep = proto.run_proto_analysis(REPO_ROOT)
+    rep["_wall_seconds"] = time.monotonic() - t0
+    return rep
+
+
+def test_full_run_clean(report):
+    assert report["errors"] == []
+    assert [f.render() for f in report["findings"]] == []
+    assert report["stale"] == []
+    assert report["unjustified"] == []
+    assert all(v == "ok" for v in report["properties"].values()), report[
+        "properties"
+    ]
+
+
+def test_report_budgets_never_silent(report):
+    """Every scenario's exploration budgets ride the report (ISSUE: a
+    truncated exploration must be visible, not silent), and the verdict
+    table names every property."""
+    assert set(report["scenarios"]) == {s.name for s in proto.SCENARIOS}
+    for name, scn in report["scenarios"].items():
+        assert set(scn) == {
+            "states",
+            "truncated",
+            "seconds",
+            "n_solves",
+            "fault_budget",
+            "max_ticks",
+            "max_states",
+        }, name
+        assert scn["states"] > 0
+        assert scn["states"] <= scn["max_states"]
+    assert set(report["properties"]) == set(proto.PROTO_RULES)
+
+
+def test_live_scenarios_ran_and_recorded(report):
+    assert set(report["conformance"]) == {"live_breaker_retry", "live_drain"}
+    for name, n_events in report["conformance"].items():
+        assert n_events > 0, name
+
+
+def test_tier_fits_one_core_budget(report):
+    """ISSUE budget: the whole tier — five explorations plus both live
+    scenarios — stays under 60s on the 1-core box so it can ride
+    pre-commit and --all."""
+    assert report["_wall_seconds"] < 60.0, report["scenarios"]
+
+
+# ---------------------------------------------------------------------------
+# canonical dedup
+
+
+def test_canonical_renumbers_epoch_labels():
+    """States differing only in which concrete epoch ids the run handed
+    out dedup to one BFS node."""
+    a = proto.World(acked_e=5, se=5, c2s=(("SOLVE", True, 5, 1),))
+    b = proto.World(acked_e=9, se=9, c2s=(("SOLVE", True, 9, 1),))
+    assert proto.canonical(a) == proto.canonical(b)
+
+
+def test_canonical_keeps_epoch_relationships():
+    """Renumbering is order-of-first-occurrence, not erasure: a client
+    acked on a DIFFERENT epoch than the server stored must not collapse
+    into the agreeing state."""
+    agree = proto.World(acked_e=5, se=5)
+    differ = proto.World(acked_e=5, se=7)
+    assert proto.canonical(agree) != proto.canonical(differ)
+
+
+def test_canonical_distinguishes_structure():
+    assert proto.canonical(proto.World(phase="wait")) != proto.canonical(
+        proto.World(phase="idle")
+    )
+    assert proto.canonical(proto.World()) == proto.canonical(proto.World())
+
+
+# ---------------------------------------------------------------------------
+# BFS shortest counterexample + shrink minimality
+
+
+def _violating_schedules_up_to(cfg, rule, depth):
+    """Every schedule of length <= depth whose replay violates `rule`
+    (exhaustive DFS over enabled labels; only used at tiny depths)."""
+    found = []
+
+    def walk(w, path):
+        if path:
+            _, viols = proto.replay(cfg, path)
+            if any(r == rule for r, _ in viols):
+                found.append(list(path))
+                return
+        if len(path) >= depth:
+            return
+        for lab, w2, _ in proto.step(cfg, w):
+            walk(w2, path + [lab])
+
+    walk(proto.initial_world(cfg.scenario), [])
+    return found
+
+
+def test_bfs_returns_a_shortest_counterexample():
+    """BFS order + one-label transitions means the first counterexample
+    per property is a shortest one; exhaustive search at smaller depths
+    confirms nothing shorter exists."""
+    scn_name, knobs = proto.BROKEN_KNOBS["proto-converge"]
+    cfg = _cfg(scn_name, knobs)
+    res = proto.explore(cfg, stop_on_first=True)
+    ce = next(c for c in res.counterexamples if c.rule == "proto-converge")
+    ce = proto.shrink(cfg, ce)
+    _, viols = proto.replay(cfg, ce.schedule)
+    assert any(r == "proto-converge" for r, _ in viols)
+    assert not _violating_schedules_up_to(
+        cfg, "proto-converge", len(ce.schedule) - 1
+    ), "a shorter schedule violates: BFS did not return a shortest path"
+
+
+def test_shrink_result_is_one_minimal():
+    """Greedy shrink's contract: dropping ANY single remaining label
+    loses the violation."""
+    scn_name, knobs = proto.BROKEN_KNOBS["proto-drain-bounded"]
+    cfg = _cfg(scn_name, knobs)
+    res = proto.explore(cfg, stop_on_first=True)
+    ce = proto.shrink(
+        cfg,
+        next(c for c in res.counterexamples if c.rule == "proto-drain-bounded"),
+    )
+    for i in range(len(ce.schedule)):
+        candidate = ce.schedule[:i] + ce.schedule[i + 1 :]
+        _, viols = proto.replay(cfg, candidate)
+        assert not any(r == ce.rule for r, _ in viols), (
+            f"dropping step {i} ({ce.schedule[i]}) still violates — "
+            "shrink returned a non-minimal schedule"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the broken-knob matrix: each pinned review fix, reverted in the MODEL,
+# is found by the checker (positive); the real knobs stay clean (negative)
+
+
+@pytest.mark.parametrize("rule", sorted(proto.BROKEN_KNOBS))
+def test_broken_knob_finds_its_property(rule):
+    scn_name, knobs = proto.BROKEN_KNOBS[rule]
+    assert knobs != proto.REAL_KNOBS
+    cfg = _cfg(scn_name, knobs)
+    res = proto.explore(cfg, stop_on_first=True)
+    ces = [c for c in res.counterexamples if c.rule == rule]
+    assert ces, (
+        f"{rule}: the deliberately-broken model found no counterexample "
+        f"in scenario {scn_name!r}"
+    )
+    shrunk = proto.shrink(cfg, ces[0])
+    _, viols = proto.replay(cfg, shrunk.schedule)
+    assert any(r == rule for r, _ in viols)
+
+
+def test_real_knobs_clean_on_quick_scenarios():
+    """The negative half on the two fastest scenarios (the full
+    five-scenario clean run is the module `report` fixture)."""
+    for scn_name in ("steady", "drain"):
+        res = proto.explore(_cfg(scn_name, proto.REAL_KNOBS))
+        assert res.counterexamples == [], scn_name
+
+
+def test_tick_budget_is_truncation_not_deadlock():
+    """A state blocked only by the max_ticks budget is the exploration
+    bound biting — reported as truncation, never a phantom converge
+    violation (the same discrimination replay applies)."""
+    scn = dataclasses.replace(
+        next(s for s in proto.SCENARIOS if s.name == "steady"), max_ticks=1
+    )
+    cfg = proto.Config(proto.REAL_KNOBS, scn)
+    res = proto.explore(cfg)
+    assert res.truncated
+    assert not any(
+        c.rule == "proto-converge" for c in res.counterexamples
+    ), "tick-budget exhaustion was misreported as a protocol deadlock"
+
+
+# ---------------------------------------------------------------------------
+# wire-kind and channel-fault parity with the real stack
+
+
+def test_kind_table_matches_service():
+    """proto.py duplicates the wire kinds (service.py imports numpy and
+    the model must stay stdlib-only): the two tables must never drift."""
+    from karpenter_tpu.solver import service
+
+    for name in (
+        "KIND_SOLVE",
+        "KIND_RESULT",
+        "KIND_ERROR",
+        "KIND_PING",
+        "KIND_PONG",
+        "KIND_SOLVE_DELTA",
+        "KIND_EPOCH_RESYNC",
+        "KIND_RETRY",
+    ):
+        assert getattr(proto, name) == getattr(service, name), name
+
+
+def test_channel_faults_mirror_fault_proxy_modes():
+    """Every byte-level fault the FaultyProxy can inject has a model
+    transition with the same observable effect, so the explorer covers
+    (at least) the fault vocabulary the live suite soaks."""
+    from karpenter_tpu.testing import faults
+
+    step_src = inspect.getsource(proto.step)
+    # proxy mode -> the model label family with the same client-visible
+    # effect (blackhole swallows the request; truncate/corrupt both
+    # poison framing beyond recovery; delay is pure elapsed time)
+    for mode, label in {
+        "blackhole": '"f_drop_c2s"',
+        "truncate": '"f_trunc_s2c"',
+        "corrupt": '"f_trunc_s2c"',
+        "delay": '"tick"',
+    }.items():
+        assert mode in faults.FaultyProxy.__doc__, mode
+        assert label in step_src, (mode, label)
+
+
+def test_channel_fault_semantics():
+    """The fault transitions do what their labels claim on the channel
+    tuples (head drop, head duplicate, head poisoned to JUNK)."""
+    cfg = _cfg("steady", proto.REAL_KNOBS)
+    w = proto.World(
+        phase="wait",
+        sent="snap",
+        conn=True,
+        c2s=(("SOLVE", True, 1, 1),),
+        s2c=(("RESULT", True, 1, 1),),
+    )
+    succs = {lab: w2 for lab, w2, _ in proto.step(cfg, w)}
+    assert succs["f_drop_c2s"].c2s == ()
+    assert succs["f_drop_s2c"].s2c == ()
+    assert succs["f_dup_s2c"].s2c == (w.s2c[0], w.s2c[0])
+    assert succs["f_trunc_s2c"].s2c[0][0] == "JUNK"
+    for lab in ("f_drop_c2s", "f_drop_s2c", "f_dup_s2c", "f_trunc_s2c"):
+        assert succs[lab].faults == w.faults + 1, lab
+
+
+def test_fault_budget_gates_the_adversary():
+    cfg = _cfg("steady", proto.REAL_KNOBS)
+    spent = proto.World(
+        phase="wait",
+        sent="snap",
+        conn=True,
+        c2s=(("SOLVE", True, 1, 1),),
+        faults=cfg.scenario.fault_budget,
+    )
+    labels = {lab for lab, _, _ in proto.step(cfg, spent)}
+    assert not any(lab.startswith("f_") for lab in labels)
+
+
+# ---------------------------------------------------------------------------
+# refinement: model traces through the SAME acceptors as recorded traces
+
+
+def test_model_done_trace_refines():
+    """Soundness half: a real-knob model run to completion emits a trace
+    the acceptors accept (else conformance findings could be acceptor
+    bugs rather than code bugs)."""
+    scn = proto.Scenario(
+        "mini", n_solves=2, faults=("drop_s2c",), fault_budget=1, max_ticks=8
+    )
+    cfg = proto.Config(proto.REAL_KNOBS, scn)
+    w0 = proto.initial_world(scn)
+    seen = {proto.canonical(w0)}
+    frontier = deque([(w0, [])])
+    schedule = None
+    while frontier:
+        w, path = frontier.popleft()
+        if proto.done(cfg, w):
+            schedule = path
+            break
+        for lab, w2, _ in proto.step(cfg, w):
+            k = proto.canonical(w2)
+            if k not in seen:
+                seen.add(k)
+                frontier.append((w2, path + [lab]))
+    assert schedule is not None
+    events = proto.trace_of(cfg, schedule)
+    assert events, "a completed solve emits protocol events"
+    assert proto.check_refinement(events) == []
+
+
+def test_broken_model_trace_fails_refinement():
+    """Completeness half: the pinned broken-knob schedules, traced
+    through the emitter, are REJECTED by the acceptors — the same
+    machinery that judges recorded real traces catches the modeled
+    regressions."""
+    for rule in ("proto-breaker-wedge", "proto-drain-bounded"):
+        path = os.path.join(CORPUS_DIR, f"{rule}__*.json")
+        (corpus_file,) = glob.glob(path)
+        with open(corpus_file, encoding="utf-8") as fh:
+            case = json.load(fh)
+        cfg = _cfg(case["scenario"], proto.Knobs(**case["knobs"]))
+        events = proto.trace_of(cfg, case["schedule"])
+        assert proto.check_refinement(events) != [], rule
+
+
+# ---------------------------------------------------------------------------
+# the acceptors on hand-built traces (one per pinned contract)
+
+
+def test_acceptor_stranded_probe():
+    events = [
+        {
+            "ev": "breaker_allow",
+            "i": 0,
+            "thread": 1,
+            "granted": True,
+            "probe": True,
+            "state": "half-open",
+            "failures": 2,
+            "threshold": 2,
+        },
+        {
+            "ev": "attempt",
+            "i": 1,
+            "thread": 1,
+            "outcome": "overloaded",
+            "breaker": "half",
+        },
+    ]
+    viols = proto.check_refinement(events)
+    assert any("STRANDED" in v for v in viols), viols
+
+
+def test_acceptor_probe_resolved_is_clean():
+    events = [
+        {
+            "ev": "breaker_allow",
+            "i": 0,
+            "thread": 1,
+            "granted": True,
+            "probe": True,
+            "state": "half-open",
+            "failures": 2,
+            "threshold": 2,
+        },
+        {
+            "ev": "breaker_success",
+            "i": 1,
+            "thread": 1,
+            "prev": "half-open",
+            "state": "closed",
+            "failures": 0,
+            "threshold": 2,
+        },
+        {
+            "ev": "attempt",
+            "i": 2,
+            "thread": 1,
+            "outcome": "overloaded",
+            "breaker": "closed",
+        },
+    ]
+    assert proto.check_refinement(events) == []
+
+
+def test_acceptor_silent_drain_close():
+    events = [
+        {
+            "ev": "srv_recv",
+            "i": 0,
+            "thread": 2,
+            "conn": 0,
+            "kind": proto.KIND_SOLVE,
+            "draining": True,
+        },
+        {"ev": "srv_close", "i": 1, "thread": 2, "conn": 0, "draining": True},
+    ]
+    viols = proto.check_refinement(events)
+    assert any("silent close" in v for v in viols), viols
+
+
+def test_acceptor_one_refusal_then_close_is_clean():
+    events = [
+        {
+            "ev": "srv_recv",
+            "i": 0,
+            "thread": 2,
+            "conn": 0,
+            "kind": proto.KIND_SOLVE,
+            "draining": True,
+        },
+        {
+            "ev": "srv_send",
+            "i": 1,
+            "thread": 2,
+            "conn": 0,
+            "kind": proto.KIND_RETRY,
+            "draining": True,
+            "refusal": True,
+        },
+        {"ev": "srv_close", "i": 2, "thread": 2, "conn": 0, "draining": True},
+    ]
+    assert proto.check_refinement(events) == []
+
+
+def test_acceptor_second_refusal():
+    recv = {
+        "ev": "srv_recv",
+        "thread": 2,
+        "conn": 0,
+        "kind": proto.KIND_SOLVE,
+        "draining": True,
+    }
+    send = {
+        "ev": "srv_send",
+        "thread": 2,
+        "conn": 0,
+        "kind": proto.KIND_RETRY,
+        "draining": True,
+        "refusal": True,
+    }
+    events = [dict(recv, i=0), dict(send, i=1), dict(recv, i=2), dict(send, i=3)]
+    viols = proto.check_refinement(events)
+    assert any("second refusal" in v for v in viols), viols
+
+
+def test_acceptor_commit_requires_store():
+    orphan = [
+        {
+            "ev": "cli_epoch_commit",
+            "i": 0,
+            "thread": 1,
+            "client": 7,
+            "epoch": 3,
+            "mode": "delta",
+        }
+    ]
+    viols = proto.check_refinement(orphan)
+    assert any("never stored" in v for v in viols), viols
+    stored_first = [
+        {
+            "ev": "srv_epoch_store",
+            "i": 0,
+            "thread": 2,
+            "client": 7,
+            "epoch": 3,
+        },
+        {
+            "ev": "cli_epoch_commit",
+            "i": 1,
+            "thread": 1,
+            "client": 7,
+            "epoch": 3,
+            "mode": "delta",
+        },
+    ]
+    assert proto.check_refinement(stored_first) == []
+
+
+def test_acceptor_store_after_commit_is_the_ordering_revert():
+    """The store-before-answer fix: a store that lands AFTER the commit
+    riding its answer is the reverted ordering, even though the store
+    eventually exists."""
+    events = [
+        {
+            "ev": "cli_epoch_commit",
+            "i": 0,
+            "thread": 1,
+            "client": 7,
+            "epoch": 3,
+            "mode": "snapshot",
+        },
+        {
+            "ev": "srv_epoch_store",
+            "i": 1,
+            "thread": 2,
+            "client": 7,
+            "epoch": 3,
+        },
+    ]
+    viols = proto.check_refinement(events)
+    assert any("AFTER" in v for v in viols), viols
+
+
+def test_acceptor_pre_epoch_snapshot_commit_is_the_fiction():
+    """Mixed-version rollout: a pre-epoch server ignores the epoch key
+    on snapshots, so a snapshot-mode commit with NO store at all is the
+    deliberate client-side fiction (service.py pre-epoch branch) — the
+    first delta's 'unknown kind' downgrade corrects it. Accepted; a
+    delta-mode commit with no store stays a violation."""
+    events = [
+        {
+            "ev": "cli_epoch_commit",
+            "i": 0,
+            "thread": 1,
+            "client": 7,
+            "epoch": 1,
+            "mode": "snapshot",
+        }
+    ]
+    assert proto.check_refinement(events) == []
+
+
+def test_acceptor_snapshot_never_answered_resync():
+    events = [
+        {
+            "ev": "cli_roundtrip",
+            "i": 0,
+            "thread": 1,
+            "client": 7,
+            "kind": proto.KIND_SOLVE,
+            "resp_kind": proto.KIND_EPOCH_RESYNC,
+            "req_id": 1,
+        }
+    ]
+    viols = proto.check_refinement(events)
+    assert any("no fallback" in v for v in viols), viols
+
+
+def test_acceptor_resync_forces_full_snapshot_next():
+    events = [
+        {
+            "ev": "cli_roundtrip",
+            "i": 0,
+            "thread": 1,
+            "client": 7,
+            "kind": proto.KIND_SOLVE_DELTA,
+            "resp_kind": proto.KIND_EPOCH_RESYNC,
+            "req_id": 1,
+        },
+        {
+            "ev": "cli_roundtrip",
+            "i": 1,
+            "thread": 1,
+            "client": 7,
+            "kind": proto.KIND_SOLVE_DELTA,
+            "resp_kind": proto.KIND_RESULT,
+            "req_id": 2,
+        },
+    ]
+    viols = proto.check_refinement(events)
+    assert any("must be" in v and "snapshot" in v for v in viols), viols
+
+
+def test_shrink_trace_keeps_only_the_implicated_stream():
+    """The conformance repro in a finding is the few frames that matter:
+    an unrelated healthy connection's events are dropped from the
+    minimal sub-trace."""
+    noise = [
+        {
+            "ev": "srv_recv",
+            "i": 0,
+            "thread": 9,
+            "conn": 5,
+            "kind": proto.KIND_PING,
+            "draining": False,
+        },
+        {
+            "ev": "srv_send",
+            "i": 1,
+            "thread": 9,
+            "conn": 5,
+            "kind": proto.KIND_PONG,
+            "draining": False,
+        },
+    ]
+    bad = [
+        {
+            "ev": "srv_recv",
+            "i": 2,
+            "thread": 2,
+            "conn": 9,
+            "kind": proto.KIND_SOLVE,
+            "draining": True,
+        },
+        {"ev": "srv_close", "i": 3, "thread": 2, "conn": 9, "draining": True},
+    ]
+    events = noise + bad
+    (violation,) = proto.check_refinement(events)
+    sub = proto.shrink_trace(events, violation)
+    assert sub == bad
+    assert violation in proto.check_refinement(sub)
+
+
+# ---------------------------------------------------------------------------
+# live conformance scenarios (named so the findings' repro hints select
+# them: `pytest tests/test_proto_analysis.py -k live_breaker_retry`)
+
+
+@pytest.mark.hard_timeout(60)
+def test_live_breaker_retry_trace_refines():
+    """The scripted real ResilientSolver recovery story — failures trip
+    the breaker, cooldown yields the half-open probe, an admission RETRY
+    resolves it closed — records a trace the model accepts; deleting the
+    RETRY-records-success event (what reverting the hybrid.py fix does)
+    strands the probe and fails refinement."""
+    events = proto.live_breaker_scenario()
+    assert proto.check_refinement(events) == []
+    outcomes = [e["outcome"] for e in events if e.get("ev") == "attempt"]
+    assert "breaker_denied" in outcomes and "overloaded" in outcomes
+    # simulated revert: drop the record_success that resolves the probe
+    idx = next(
+        i
+        for i, e in enumerate(events)
+        if e.get("ev") == "attempt" and e["outcome"] == "overloaded"
+    )
+    assert events[idx - 1]["ev"] == "breaker_success"
+    doctored = events[: idx - 1] + events[idx:]
+    assert any("STRANDED" in v for v in proto.check_refinement(doctored))
+
+
+@pytest.mark.hard_timeout(60)
+def test_live_drain_trace_refines():
+    """The real SolverServer over raw sockets: stop() with one solve in
+    flight and one arriving mid-drain — the refusal answer and the
+    RESULT flush both precede their closes; deleting the refusal send
+    (the service.py revert) is the silent drain close."""
+    events = proto.live_drain_scenario()
+    assert proto.check_refinement(events) == []
+    refusals = [e for e in events if e.get("refusal")]
+    assert len(refusals) == 1
+    flushed = [
+        e
+        for e in events
+        if e.get("ev") == "srv_send" and e.get("kind") == proto.KIND_RESULT
+    ]
+    assert flushed, "the in-flight solve's RESULT must flush during drain"
+    doctored = [e for e in events if not e.get("refusal")]
+    assert any(
+        "silent close" in v for v in proto.check_refinement(doctored)
+    )
+
+
+# ---------------------------------------------------------------------------
+# the recorder: zero disabled cost, and the autouse conformance fixture
+
+
+def test_recorder_disabled_by_default():
+    assert protorec.RECORDER is None
+    assert protorec.active() is None
+
+
+def test_hook_sites_guard_on_one_attribute_load():
+    """Every protorec call in the serving code is inside an
+    `if protorec.RECORDER is not None:` guard — the disabled cost is one
+    module-attribute load and an identity test, nothing else (no dict
+    building, no conn_id bookkeeping)."""
+
+    def guard_test(node) -> bool:
+        t = node.test
+        return (
+            isinstance(t, ast.Compare)
+            and isinstance(t.ops[0], ast.IsNot)
+            and ast.unparse(t.left) == "protorec.RECORDER"
+        )
+
+    for rel in ("karpenter_tpu/solver/hybrid.py", "karpenter_tpu/solver/service.py"):
+        src = open(os.path.join(REPO_ROOT, rel), encoding="utf-8").read()
+        tree = ast.parse(src)
+        guarded_spans = [
+            (n.lineno, max(x.end_lineno for x in n.body))
+            for n in ast.walk(tree)
+            if isinstance(n, ast.If) and guard_test(n)
+        ]
+        assert guarded_spans, rel
+        uses = [
+            n.lineno
+            for n in ast.walk(tree)
+            if isinstance(n, ast.Attribute)
+            and ast.unparse(n).startswith("protorec.RECORDER.")
+        ]
+        assert uses, rel
+        for line in uses:
+            assert any(lo <= line <= hi for lo, hi in guarded_spans), (
+                f"{rel}:{line}: protorec.RECORDER use outside the "
+                "`is not None` guard — the disabled path must stay free"
+            )
+
+
+def test_disabled_hook_cost_micro_assert():
+    """The pinned micro-assert from the protorec docstring: the disabled
+    hook predicate averages well under 5µs/call on the 1-core box (real
+    cost is tens of ns; the generous bound only catches accidental work
+    on the disabled path, e.g. building the event dict eagerly)."""
+    assert protorec.RECORDER is None
+    n = 100_000
+    t0 = time.perf_counter()
+    hits = 0
+    for _ in range(n):
+        if protorec.RECORDER is not None:
+            hits += 1  # pragma: no cover - recorder is off
+    elapsed = time.perf_counter() - t0
+    assert hits == 0
+    assert elapsed / n < 5e-6, f"{elapsed / n * 1e9:.0f}ns per disabled hook"
+
+
+def test_recorder_conn_ids_never_alias():
+    rec = protorec.TraceRecorder()
+
+    class Sock:
+        pass
+
+    a = Sock()
+    ida = rec.conn_id(a)
+    assert rec.conn_id(a) == ida  # stable while live
+    assert rec.conn_closed(a) == ida
+    b = Sock()  # may land on the recycled id() address
+    assert rec.conn_id(b) != ida or id(b) != id(a)
+    # the guarantee under recycling: a closed conn's id is retired
+    assert rec.conn_id(b) == rec.conn_id(b)
+
+
+@pytest.mark.proto
+def test_proto_marker_installs_recorder_and_checks(request):
+    """The satellite-2 end-to-end: `@pytest.mark.proto` (and every
+    `faults` test) runs with a live recorder installed by the conftest
+    fixture, and the teardown refinement check judges what we record
+    here — a legal closed-breaker cycle."""
+    assert protorec.RECORDER is not None, (
+        "tests/conftest.py _proto_conformance must install a recorder "
+        "for proto-marked tests"
+    )
+    from karpenter_tpu.solver.hybrid import CircuitBreaker
+
+    br = CircuitBreaker(failure_threshold=2, cooldown_seconds=10.0)
+    assert br.allow()
+    br.record_success()
+    evs = [e["ev"] for e in protorec.RECORDER.snapshot()]
+    assert "breaker_allow" in evs and "breaker_success" in evs
+    # teardown now runs check_refinement over exactly these events
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, flag discipline, and the five-tier --all merge
+
+
+def _fake_report(findings=(), errors=(), stale=(), unjustified=()):
+    return {
+        "findings": list(findings),
+        "all_findings": list(findings),
+        "stale": list(stale),
+        "unjustified": list(unjustified),
+        "errors": list(errors),
+        "total": len(findings),
+        "scenarios": {
+            "steady": {
+                "states": 11,
+                "truncated": False,
+                "seconds": 0.1,
+                "n_solves": 3,
+                "fault_budget": 1,
+                "max_ticks": 10,
+                "max_states": 200_000,
+            }
+        },
+        "properties": {r: "ok" for r in proto.PROTO_RULES},
+        "conformance": {"live_breaker_retry": 14, "live_drain": 8},
+    }
+
+
+_FINDING = Finding(
+    rule="proto-conformance",
+    path="karpenter_tpu/solver/hybrid.py",
+    line=1,
+    message="doctored",
+    text="live_breaker_retry:doctored",
+)
+
+
+def test_cli_proto_exit_codes(monkeypatch, capsys):
+    monkeypatch.setattr(
+        proto, "run_proto_analysis", lambda *a, **k: _fake_report()
+    )
+    assert graftlint_main(["--proto", "--root", REPO_ROOT, "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    # the budgets and verdicts ride the JSON payload (never silent)
+    assert data["scenarios"]["steady"]["max_states"] == 200_000
+    assert data["properties"]["proto-converge"] == "ok"
+    assert data["conformance"]["live_drain"] == 8
+
+    monkeypatch.setattr(
+        proto,
+        "run_proto_analysis",
+        lambda *a, **k: _fake_report(findings=[_FINDING]),
+    )
+    assert graftlint_main(["--proto", "--root", REPO_ROOT]) == 1
+    assert "proto-conformance" in capsys.readouterr().out
+
+    monkeypatch.setattr(
+        proto,
+        "run_proto_analysis",
+        lambda *a, **k: _fake_report(errors=["live_drain: died"]),
+    )
+    assert graftlint_main(["--proto", "--root", REPO_ROOT]) == 2
+    assert "scenario error" in capsys.readouterr().out
+
+
+def test_cli_proto_truncation_named_in_summary(monkeypatch, capsys):
+    rep = _fake_report()
+    rep["scenarios"]["steady"]["truncated"] = True
+    monkeypatch.setattr(proto, "run_proto_analysis", lambda *a, **k: rep)
+    assert graftlint_main(["--proto", "--root", REPO_ROOT]) == 0
+    assert "truncated: steady" in capsys.readouterr().out
+
+
+def test_cli_proto_rejects_meaningless_flags(capsys):
+    assert graftlint_main(["--proto", "--root", REPO_ROOT, "x.py"]) == 2
+    assert (
+        graftlint_main(["--proto", "--root", REPO_ROOT, "--changed-only"]) == 2
+    )
+    assert (
+        graftlint_main(
+            ["--proto", "--root", REPO_ROOT, "--rules", "proto-converge"]
+        )
+        == 2
+    )
+    assert (
+        graftlint_main(["--proto", "--root", REPO_ROOT, "--budgets", "x.json"])
+        == 2
+    )
+    err = capsys.readouterr().err
+    assert "one exploration" in err
+
+
+def test_cli_proto_write_baseline_refused_on_errors(monkeypatch, capsys, tmp_path):
+    monkeypatch.setattr(
+        proto,
+        "run_proto_analysis",
+        lambda *a, **k: _fake_report(errors=["live_drain: died"]),
+    )
+    baseline = tmp_path / "graftlint.proto.baseline.json"
+    rc = graftlint_main(
+        [
+            "--proto",
+            "--root",
+            REPO_ROOT,
+            "--write-baseline",
+            "--baseline",
+            str(baseline),
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 2
+    assert not baseline.exists()
+
+
+def test_cli_list_rules_shows_proto(capsys):
+    assert graftlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in proto.PROTO_RULES:
+        assert rid in out
+    assert "[proto]" in out
+
+
+def test_cli_jobs_requires_all(capsys):
+    assert graftlint_main(["--root", REPO_ROOT, "--jobs", "2"]) == 2
+    assert graftlint_main(["--proto", "--root", REPO_ROOT, "--jobs", "2"]) == 2
+    assert graftlint_main(["--all", "--root", REPO_ROOT, "--jobs", "0"]) == 2
+    err = capsys.readouterr().err
+    assert "--jobs" in err
+
+
+def _stub_all_tiers(monkeypatch, proto_report=None, race_errors=()):
+    import karpenter_tpu.analysis.__main__ as cli
+    from karpenter_tpu.analysis import ir, locks, spmd
+
+    flat = {
+        "findings": [],
+        "stale": [],
+        "unjustified": [],
+        "errors": [],
+        "total": 0,
+    }
+    deep = dict(
+        flat,
+        all_findings=[],
+        budget_unjustified=[],
+        improvements=[],
+        measured={},
+    )
+    monkeypatch.setattr(cli, "run_analysis", lambda *a, **k: dict(flat))
+    monkeypatch.setattr(
+        locks,
+        "run_race_analysis",
+        lambda *a, **k: dict(flat, errors=list(race_errors)),
+    )
+    monkeypatch.setattr(ir, "run_ir_analysis", lambda *a, **k: dict(deep))
+    monkeypatch.setattr(spmd, "run_spmd_analysis", lambda *a, **k: dict(deep))
+    monkeypatch.setattr(
+        proto,
+        "run_proto_analysis",
+        lambda *a, **k: proto_report or _fake_report(),
+    )
+
+
+def test_cli_all_includes_proto_tier(monkeypatch, capsys):
+    _stub_all_tiers(monkeypatch)
+    rc = graftlint_main(["--all", "--root", REPO_ROOT, "--json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert set(data) == {"ast", "race", "ir", "spmd", "proto", "exit_code"}
+    assert data["proto"]["exit_code"] == 0
+    assert data["proto"]["properties"]["proto-converge"] == "ok"
+    assert isinstance(data["proto"]["seconds"], float)
+
+
+def test_cli_all_jobs_parallel_merges_identically(monkeypatch, capsys):
+    """--jobs N is a scheduling choice, not a semantic one: the merged
+    payload has the same tiers, shapes, and worst exit code as the
+    serial path."""
+    _stub_all_tiers(monkeypatch)
+    rc = graftlint_main(["--all", "--jobs", "3", "--root", REPO_ROOT, "--json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert set(data) == {"ast", "race", "ir", "spmd", "proto", "exit_code"}
+    for tier in ("ast", "race", "ir", "spmd", "proto"):
+        assert data[tier]["exit_code"] == 0
+        assert isinstance(data[tier]["seconds"], float)
+
+
+def test_cli_all_jobs_worst_exit_propagates(monkeypatch, capsys):
+    _stub_all_tiers(
+        monkeypatch,
+        proto_report=_fake_report(findings=[_FINDING]),
+        race_errors=["parse error: doctored"],
+    )
+    rc = graftlint_main(["--all", "--jobs", "2", "--root", REPO_ROOT, "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert data["proto"]["exit_code"] == 1
+    assert data["race"]["exit_code"] == 2
+    assert rc == 2 and data["exit_code"] == 2
+
+
+def test_cli_all_proto_crash_is_broken_gate(monkeypatch, capsys):
+    _stub_all_tiers(monkeypatch)
+
+    def boom(*a, **k):
+        raise RuntimeError("live scenario wedged")
+
+    monkeypatch.setattr(proto, "run_proto_analysis", boom)
+    rc = graftlint_main(["--all", "--jobs", "2", "--root", REPO_ROOT, "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert data["proto"]["exit_code"] == 2
+    assert "live scenario wedged" in data["proto"]["unavailable"]

@@ -1268,6 +1268,16 @@ def test_cli_list_rules_includes_race_tier(capsys):
     assert "[race]" in out
 
 
+def _proto_tier_stub(*a, **kw):
+    """Clean proto-tier report: the real exploration has its own tier-1
+    gate in test_proto_analysis.py; --all plumbing tests stub it."""
+    return {
+        "findings": [], "all_findings": [], "stale": [], "unjustified": [],
+        "errors": [], "total": 0, "scenarios": {}, "properties": {},
+        "conformance": {},
+    }
+
+
 def test_cli_all_rejects_write_and_subset_modes(capsys):
     assert graftlint_main(["--root", REPO_ROOT, "--all", "--write-baseline"]) == 2
     assert graftlint_main(["--root", REPO_ROOT, "--all", "--rules", "milli-units"]) == 2
@@ -1310,7 +1320,7 @@ def test_cli_all_forwards_reference_root(tmp_path, monkeypatch, capsys):
             "total": 0, "all_findings": [],
         }
 
-    from karpenter_tpu.analysis import ir, locks, spmd
+    from karpenter_tpu.analysis import ir, locks, proto, spmd
 
     monkeypatch.setattr(cli, "run_analysis", fake_run_analysis)
     monkeypatch.setattr(locks, "run_race_analysis", fake_race)
@@ -1320,6 +1330,7 @@ def test_cli_all_forwards_reference_root(tmp_path, monkeypatch, capsys):
     }
     monkeypatch.setattr(ir, "run_ir_analysis", traced_tier_stub)
     monkeypatch.setattr(spmd, "run_spmd_analysis", traced_tier_stub)
+    monkeypatch.setattr(proto, "run_proto_analysis", _proto_tier_stub)
     (tmp_path / "karpenter_tpu").mkdir()
     rc = graftlint_main(
         ["--root", str(tmp_path), "--all", "--reference-root", "/elsewhere/ref"]
@@ -1345,7 +1356,9 @@ def test_cli_all_text_mode_itemizes_baseline_problems(tmp_path, capsys, monkeypa
     """An exit-1 --all run must name each stale/unjustified entry (with
     its tier prefix) exactly as the single-tier modes do — an aggregate
     count alone is not actionable in a CI log."""
-    from karpenter_tpu.analysis import ir, spmd
+    from karpenter_tpu.analysis import ir, proto, spmd
+
+    monkeypatch.setattr(proto, "run_proto_analysis", _proto_tier_stub)
 
     def fake_ir(repo_root, budgets_path=None, baseline_path=None, rule_ids=None):
         return {
@@ -1391,12 +1404,14 @@ def test_cli_all_text_mode_itemizes_baseline_problems(tmp_path, capsys, monkeypa
 
 
 def test_cli_all_merges_tiers_with_worst_exit_code(capsys, monkeypatch):
-    """--all = AST + race + IR + SPMD with one worst-case exit code. The
-    traced tiers are stubbed here (the real trace/compile runs have their
-    own tier-1 gates in test_ir_analysis.py / test_spmd_analysis.py;
-    running them twice per suite would double that cost for no new
-    coverage)."""
-    from karpenter_tpu.analysis import ir, spmd
+    """--all = AST + race + IR + SPMD + proto with one worst-case exit
+    code. The traced tiers are stubbed here (the real trace/compile/
+    exploration runs have their own tier-1 gates in test_ir_analysis.py /
+    test_spmd_analysis.py / test_proto_analysis.py; running them twice
+    per suite would double that cost for no new coverage)."""
+    from karpenter_tpu.analysis import ir, proto, spmd
+
+    monkeypatch.setattr(proto, "run_proto_analysis", _proto_tier_stub)
 
     def fake_ir(repo_root, budgets_path=None, baseline_path=None, rule_ids=None):
         return {
@@ -1428,7 +1443,7 @@ def test_cli_all_merges_tiers_with_worst_exit_code(capsys, monkeypatch):
     rc = graftlint_main(["--root", REPO_ROOT, "--all", "--json"])
     data = json.loads(capsys.readouterr().out)
     assert rc == 0
-    assert set(data) == {"ast", "race", "ir", "spmd", "exit_code"}
+    assert set(data) == {"ast", "race", "ir", "spmd", "proto", "exit_code"}
     assert data["exit_code"] == 0
     assert data["ast"]["findings"] == [] and data["race"]["findings"] == []
     assert data["ir"]["exit_code"] == 0

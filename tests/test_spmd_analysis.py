@@ -643,7 +643,7 @@ def test_cli_list_rules_shows_spmd(capsys):
 
 def _stub_tier_reports(monkeypatch, report, spmd_findings=()):
     import karpenter_tpu.analysis.__main__ as cli
-    from karpenter_tpu.analysis import ir, locks
+    from karpenter_tpu.analysis import ir, locks, proto
 
     flat = {
         "findings": [],
@@ -668,17 +668,28 @@ def _stub_tier_reports(monkeypatch, report, spmd_findings=()):
     )
     monkeypatch.setattr(ir, "run_ir_analysis", lambda *a, **k: dict(deep, findings=[], all_findings=[]))
     monkeypatch.setattr(spmd, "run_spmd_analysis", lambda *a, **k: deep)
+    monkeypatch.setattr(
+        proto,
+        "run_proto_analysis",
+        lambda *a, **k: dict(
+            flat,
+            all_findings=[],
+            scenarios={},
+            properties={},
+            conformance={},
+        ),
+    )
 
 
-def test_cli_all_merges_four_tiers_with_seconds(
+def test_cli_all_merges_five_tiers_with_seconds(
     monkeypatch, capsys, report
 ):
     _stub_tier_reports(monkeypatch, report)
     rc = graftlint_main(["--all", "--root", REPO_ROOT, "--json"])
     assert rc == 0
     data = json.loads(capsys.readouterr().out)
-    assert set(data) >= {"ast", "race", "ir", "spmd", "exit_code"}
-    for tier in ("ast", "race", "ir", "spmd"):
+    assert set(data) >= {"ast", "race", "ir", "spmd", "proto", "exit_code"}
+    for tier in ("ast", "race", "ir", "spmd", "proto"):
         assert data[tier]["exit_code"] == 0
         # the drive-by: per-tier wall-clock in the merged payload
         assert isinstance(data[tier]["seconds"], float)
